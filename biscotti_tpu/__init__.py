@@ -1,0 +1,23 @@
+"""biscotti_tpu — a TPU-native decentralized secure federated-learning framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of Biscotti
+(arXiv:1811.09904; reference implementation in Go + embedded CPython):
+peer-to-peer multi-party ML where N peers each hold a private shard, take
+local SGD steps, and commit one global model per blockchain block, with
+
+  * stake-weighted VRF role election (verifier / miner / noiser committees),
+  * differential-privacy noising (pre-sampled Gaussian, committee-averaged),
+  * Krum / RONI Byzantine-update filtering,
+  * polynomial-commitment + Shamir-secret-sharing secure aggregation.
+
+Design stance (see SURVEY.md §7): all round math — local SGD, DP noise,
+Krum's O(n²) distance scan, quantization, share generation / homomorphic
+aggregation / recovery — is jitted XLA over device buffers; peers map to a
+vmapped batch on one chip (simulation) or to hosts over a gRPC-style mesh
+(deployment); the ledger, VRF, and elliptic-curve crypto live in the host
+control plane (C++ native library + Python orchestration).
+"""
+
+__version__ = "0.1.0"
+
+from biscotti_tpu.config import BiscottiConfig, Defense  # noqa: F401

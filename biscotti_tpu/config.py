@@ -1,0 +1,219 @@
+"""Typed configuration covering the reference's full flag + constant surface.
+
+Mirrors the CLI flags of the reference protocol binary
+(ref: DistSys/main.go:613-649) and its compile-time constants
+(ref: DistSys/main.go:28-60), plus TPU topology fields that have no
+reference analogue. Derived quantities (NUM_SAMPLES, KRUM_UPDATETHRESH,
+TOTAL_SHARES, collusion threshold; ref: DistSys/main.go:670-687,825-831)
+are computed properties so they can never drift from the primary fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import math
+from dataclasses import dataclass, field
+
+
+class Defense(str, enum.Enum):
+    """Poisoning-defense selection (ref: DistSys/main.go:57 POISON_DEFENSE)."""
+
+    NONE = "NONE"
+    KRUM = "KRUM"
+    RONI = "RONI"
+
+
+@dataclass
+class Timeouts:
+    """Deadline-timer constants, in seconds (ref: DistSys/main.go:28-36).
+
+    The reference scales these by node count and committee sizes at startup
+    (ref: DistSys/main.go:786-825); `scaled()` reproduces that behavior.
+    """
+
+    update_s: float = 90.0
+    block_s: float = 300.0
+    krum_s: float = 60.0
+    share_s: float = 90.0
+    rpc_s: float = 120.0
+
+    def scaled(self, num_nodes: int, num_verifiers: int, num_miners: int) -> "Timeouts":
+        # Larger meshes and committees need proportionally longer deadlines;
+        # the reference multiplies its base constants by ceil(N/100)-style
+        # factors (ref: DistSys/main.go:786-825). We scale linearly in the
+        # same spirit, clamped so small local tests stay fast.
+        f = max(1.0, num_nodes / 100.0) * max(1.0, (num_verifiers + num_miners) / 6.0)
+        return Timeouts(
+            update_s=self.update_s * f,
+            block_s=self.block_s * f,
+            krum_s=self.krum_s * f,
+            share_s=self.share_s * f,
+            rpc_s=self.rpc_s * f,
+        )
+
+
+@dataclass
+class BiscottiConfig:
+    # --- identity / topology (ref flags -i -t -p -pa -a, main.go:613-649) ---
+    node_id: int = 0
+    num_nodes: int = 10
+    dataset: str = "creditcard"
+    peers_file: str = ""
+    my_ip: str = "127.0.0.1"
+    public_ip: str = ""
+    base_port: int = 8000
+
+    # --- committees (ref flags -na -nv -nn, main.go:629-633) ---
+    num_miners: int = 3  # "aggregators" in the reference
+    num_verifiers: int = 3
+    num_noisers: int = 2
+
+    # --- toggles (ref flags -sa -np -vp, main.go:635-641) ---
+    secure_agg: bool = True
+    noising: bool = True
+    verification: bool = True
+
+    # --- privacy / attack (ref flags -ep -po -c, main.go:625,643-647) ---
+    epsilon: float = 1.0
+    delta: float = 1e-5
+    poison_fraction: float = 0.0
+    colluders: int = 0
+    dp_in_model: bool = False  # DP_IN_MODEL mode (ref: main.go:155,860-864)
+
+    # --- sampling (ref flags -ns -rs, main.go:645,649) ---
+    sample_percent: float = 0.70  # NUM_SAMPLES = 70% of contributors
+    random_sampling: bool = False
+    krum_sample_size: int = 0  # 0 = use all collected updates
+
+    # --- protocol constants (ref: DistSys/main.go:28-60) ---
+    default_stake: int = 10  # DEFAULT_STAKE (main.go:39)
+    stake_unit: int = 5  # STAKE_UNIT (honest.go:46)
+    precision: int = 4  # decimal digits kept by quantization (main.go:45)
+    poly_size: int = 10  # Shamir chunk degree (main.go:46)
+    max_iterations: int = 100  # MAX_ITERATIONS (main.go:48)
+    fail_prob: float = 0.0  # random per-iteration self-crash (main.go:54-55)
+    defense: Defense = Defense.KRUM  # POISON_DEFENSE (main.go:57)
+    roni_threshold: float = 0.02  # RONI reject score (main.go:203-231)
+    convergence_error: float = 0.05  # train-error exit threshold
+    timeouts: Timeouts = field(default_factory=Timeouts)
+
+    # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
+    learning_rate: float = 1e-3
+    momentum: float = 0.75
+    weight_decay: float = 1e-3
+    grad_clip: float = 100.0
+    batch_size: int = 10
+    noise_presample_iters: int = 100  # DP noise tensor depth (client_obj.py:59-67)
+
+    # --- TPU topology (no reference analogue) ---
+    mesh_shape: tuple = (1,)
+    mesh_axes: tuple = ("peers",)
+    param_dtype: str = "float32"
+    seed: int = 0
+
+    # ------------------------------------------------------------------ derived
+
+    @property
+    def num_samples(self) -> int:
+        """Per-round sampled contributor count: floor(N·perc), clamped to the
+        worker population N − verifiers − miners (ref: main.go:672-679)."""
+        n = int(self.num_nodes * self.sample_percent)
+        return max(1, min(n, self.num_nodes - self.num_verifiers - self.num_miners))
+
+    @property
+    def krum_update_thresh(self) -> int:
+        """Updates a verifier collects before running Krum: the full worker
+        population under random sampling, NUM_SAMPLES otherwise
+        (ref: main.go:680-684)."""
+        if self.random_sampling:
+            return max(1, self.num_nodes - self.num_verifiers - self.num_miners)
+        return self.num_samples
+
+    @property
+    def total_shares(self) -> int:
+        """TOTAL_SHARES = ceil(2·POLY_SIZE/NUM_MINERS)·NUM_MINERS (ref: main.go:825)."""
+        return int(math.ceil(2.0 * self.poly_size / self.num_miners)) * self.num_miners
+
+    @property
+    def shares_per_miner(self) -> int:
+        return self.total_shares // self.num_miners
+
+    @property
+    def collusion_probability(self) -> float:
+        """PRIV_PROB: `colluders` is a percentage (ref: main.go:829)."""
+        return self.colluders / 100.0
+
+    @property
+    def collusion_threshold(self) -> int:
+        """collusionThresh = ceil(N · (1 − colluders/100)) (ref: main.go:830-831)."""
+        return int(math.ceil(self.num_nodes * (1.0 - self.collusion_probability)))
+
+    @property
+    def quant_scale(self) -> float:
+        return float(10 ** self.precision)
+
+    def port_of(self, node_id: int) -> int:
+        return self.base_port + node_id
+
+    # ------------------------------------------------------------------ CLI
+
+    @staticmethod
+    def add_args(p: argparse.ArgumentParser) -> None:
+        """Register the reference-compatible flag surface (ref: main.go:613-649)."""
+        p.add_argument("-i", "--node-id", type=int, default=0)
+        p.add_argument("-t", "--num-nodes", type=int, default=10)
+        p.add_argument("-d", "--dataset", type=str, default="creditcard")
+        p.add_argument("-f", "--peers-file", type=str, default="")
+        p.add_argument("-a", "--my-ip", type=str, default="127.0.0.1")
+        p.add_argument("-pa", "--public-ip", type=str, default="")
+        p.add_argument("-p", "--base-port", type=int, default=8000)
+        p.add_argument("-c", "--colluders", type=int, default=0)
+        p.add_argument("-na", "--num-miners", type=int, default=3)
+        p.add_argument("-nv", "--num-verifiers", type=int, default=3)
+        p.add_argument("-nn", "--num-noisers", type=int, default=2)
+        p.add_argument("-sa", "--secure-agg", type=int, default=1)
+        p.add_argument("-np", "--noising", type=int, default=1)
+        p.add_argument("-vp", "--verification", type=int, default=1)
+        p.add_argument("-ep", "--epsilon", type=float, default=1.0)
+        p.add_argument("-po", "--poison-fraction", type=float, default=0.0)
+        p.add_argument("-ns", "--sample-percent", type=float, default=70.0)
+        p.add_argument("-rs", "--random-sampling", type=int, default=0)
+        p.add_argument("--defense", type=str, default="KRUM", choices=[d.value for d in Defense])
+        p.add_argument("--max-iterations", type=int, default=100)
+        p.add_argument("--fail-prob", type=float, default=0.0)
+        p.add_argument("--seed", type=int, default=0)
+
+    @classmethod
+    def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
+        sample = ns.sample_percent
+        if sample > 1.0:  # reference passes -ns as a percentage (e.g. 70)
+            sample = sample / 100.0
+        return cls(
+            node_id=ns.node_id,
+            num_nodes=ns.num_nodes,
+            dataset=ns.dataset,
+            peers_file=ns.peers_file,
+            my_ip=ns.my_ip,
+            public_ip=ns.public_ip,
+            base_port=ns.base_port,
+            colluders=ns.colluders,
+            num_miners=ns.num_miners,
+            num_verifiers=ns.num_verifiers,
+            num_noisers=ns.num_noisers,
+            secure_agg=bool(ns.secure_agg),
+            noising=bool(ns.noising),
+            verification=bool(ns.verification),
+            epsilon=ns.epsilon,
+            poison_fraction=ns.poison_fraction,
+            sample_percent=sample,
+            random_sampling=bool(ns.random_sampling),
+            defense=Defense(ns.defense),
+            max_iterations=ns.max_iterations,
+            fail_prob=ns.fail_prob,
+            seed=ns.seed,
+        )
+
+    def replace(self, **kw) -> "BiscottiConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,4 @@
+from biscotti_tpu.ledger.block import Block, BlockData, Update, genesis_block
+from biscotti_tpu.ledger.chain import Blockchain
+
+__all__ = ["Block", "BlockData", "Update", "Blockchain", "genesis_block"]

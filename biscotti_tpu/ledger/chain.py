@@ -1,0 +1,130 @@
+"""In-memory hash chain with the reference's append/replace semantics.
+
+Capability parity with DistSys/blockchain.go:
+  * AddBlock / getBlock / getLatestGradient / getLatestBlockHash / PrintChain
+    (ref: DistSys/blockchain.go:12-96)
+  * structural invariant chain[i].iteration == i-1, enforced fatally
+    (ref: DistSys/blockchain.go:77-96)
+  * block-quality ordering — matching prev-hash first, then non-empty beats
+    empty (ref: DistSys/honest.go:631-647) — and same-height replacement
+    (ref: DistSys/honest.go:649-653)
+  * longest-chain adoption for late joiners (ref: DistSys/main.go:1001-1013)
+
+`dump()` is the chain-equality oracle: every peer prints its chain at exit
+and all dumps must be byte-identical (ref: DistSys/localTest.sh:40-96).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from biscotti_tpu.ledger.block import Block, genesis_block
+
+
+class ChainInvariantError(RuntimeError):
+    pass
+
+
+class Blockchain:
+    def __init__(self, num_params: int, num_nodes: int, default_stake: int = 10):
+        self.blocks: List[Block] = [genesis_block(num_params, num_nodes, default_stake)]
+
+    # ------------------------------------------------------------- accessors
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def latest(self) -> Block:
+        return self.blocks[-1]
+
+    def get_block(self, iteration: int) -> Optional[Block]:
+        idx = iteration + 1
+        if 0 <= idx < len(self.blocks):
+            return self.blocks[idx]
+        return None
+
+    def latest_gradient(self) -> np.ndarray:
+        """Copy of the current global model (ref: blockchain.go:31-37)."""
+        return self.latest.data.global_w.copy()
+
+    def latest_hash(self) -> bytes:
+        return self.latest.hash
+
+    def latest_stake_map(self) -> Dict[int, int]:
+        return dict(self.latest.stake_map)
+
+    @property
+    def next_iteration(self) -> int:
+        return self.latest.iteration + 1
+
+    # ------------------------------------------------------------- mutation
+
+    def _check_links(self, blk: Block) -> None:
+        if blk.iteration != self.latest.iteration + 1:
+            raise ChainInvariantError(
+                f"append iteration {blk.iteration} onto chain at {self.latest.iteration}"
+            )
+        if blk.prev_hash != self.latest.hash:
+            raise ChainInvariantError("block prev-hash does not link to chain head")
+
+    def add_block(self, blk: Block) -> None:
+        """Append, enforcing chain[i].iteration == i-1 (ref: blockchain.go:77-96)."""
+        self._check_links(blk)
+        if blk.hash != blk.compute_hash():
+            raise ChainInvariantError("block hash does not match contents")
+        self.blocks.append(blk)
+
+    @staticmethod
+    def block_quality(blk: Block, prev_hash: bytes) -> int:
+        """Ordering key: prev-hash match dominates, then non-empty beats empty
+        (ref: DistSys/honest.go:631-647)."""
+        return (2 if blk.prev_hash == prev_hash else 0) + (0 if blk.is_empty() else 1)
+
+    def consider_block(self, blk: Block) -> bool:
+        """Add / replace / ignore an incoming block for its height.
+
+        Returns True if the chain changed. Same-height replacement keeps the
+        higher-quality block (ref: honest.go:649-653); future blocks are the
+        caller's problem (the runtime parks them, ref: main.go:1300-1320).
+        """
+        if blk.iteration == self.latest.iteration + 1:
+            prev = self.latest.hash
+            if blk.prev_hash != prev:
+                return False
+            self.add_block(blk)
+            return True
+        if blk.iteration == self.latest.iteration and len(self.blocks) >= 2:
+            if blk.hash != blk.compute_hash():
+                return False
+            prev = self.blocks[-2].hash
+            if self.block_quality(blk, prev) > self.block_quality(self.latest, prev):
+                self.blocks[-1] = blk
+                return True
+        return False
+
+    def maybe_adopt(self, other: "Blockchain") -> bool:
+        """Longest-chain adoption on (re)join (ref: main.go:1001-1013)."""
+        if len(other.blocks) > len(self.blocks):
+            self.blocks = list(other.blocks)
+            return True
+        return False
+
+    # ------------------------------------------------------------- oracle
+
+    def dump(self) -> str:
+        """Deterministic chain dump; byte-equality across peers is the
+        top-level integration oracle (ref: DistSys/localTest.sh:40-96)."""
+        return "\n".join(b.summary() for b in self.blocks)
+
+    def verify(self) -> None:
+        """Full structural re-check: hashes, links, iteration numbering."""
+        for i, b in enumerate(self.blocks):
+            if b.iteration != i - 1:
+                raise ChainInvariantError(f"block {i} has iteration {b.iteration}")
+            if b.hash != b.compute_hash():
+                raise ChainInvariantError(f"block {i} hash mismatch")
+            if i > 0 and b.prev_hash != self.blocks[i - 1].hash:
+                raise ChainInvariantError(f"block {i} prev-hash mismatch")

@@ -1,0 +1,48 @@
+"""Config parity tests against the reference's derived-quantity formulas
+(ref: DistSys/main.go:670-687,825-831)."""
+
+import argparse
+
+from biscotti_tpu import BiscottiConfig
+
+
+def _cfg(**kw):
+    return BiscottiConfig(**kw)
+
+
+def test_num_samples_floor_and_clamp():
+    # floor(N·perc) then clamp to N − verifiers − miners (ref: main.go:672-679)
+    c = _cfg(num_nodes=10, sample_percent=0.70, num_verifiers=3, num_miners=3)
+    assert c.num_samples == 4  # floor(7) clamped to 10-3-3
+    c = _cfg(num_nodes=100, sample_percent=0.70, num_verifiers=3, num_miners=3)
+    assert c.num_samples == 70  # no clamp needed
+
+
+def test_krum_thresh_random_sampling():
+    c = _cfg(num_nodes=100, sample_percent=0.35, random_sampling=True,
+             num_verifiers=3, num_miners=3)
+    assert c.krum_update_thresh == 94  # ref: main.go:680-682
+    c = _cfg(num_nodes=100, sample_percent=0.35, random_sampling=False,
+             num_verifiers=3, num_miners=3)
+    assert c.krum_update_thresh == c.num_samples == 35
+
+
+def test_collusion_threshold_percentage():
+    c = _cfg(num_nodes=100, colluders=20)
+    assert c.collusion_probability == 0.20
+    assert c.collusion_threshold == 80  # ceil(100·0.8), ref: main.go:830-831
+
+
+def test_total_shares_formula():
+    c = _cfg(poly_size=10, num_miners=3)
+    assert c.total_shares == 21 and c.shares_per_miner == 7
+    c = _cfg(poly_size=10, num_miners=4)
+    assert c.total_shares == 20 and c.shares_per_miner == 5
+
+
+def test_cli_percentage_normalisation():
+    p = argparse.ArgumentParser()
+    BiscottiConfig.add_args(p)
+    ns = p.parse_args(["-t", "100", "-ns", "70", "-sa", "0"])
+    c = BiscottiConfig.from_args(ns)
+    assert c.sample_percent == 0.70 and not c.secure_agg

@@ -1,0 +1,133 @@
+"""Ledger unit tests: hashing determinism, chain invariants, replace/adopt
+semantics, and the cross-process chain-equality oracle property."""
+
+import numpy as np
+import pytest
+
+from biscotti_tpu.ledger import Block, BlockData, Blockchain, Update, genesis_block
+from biscotti_tpu.ledger.chain import ChainInvariantError
+
+
+def _mk_block(chain: Blockchain, d: int = 8, ndeltas: int = 2, tag: float = 1.0) -> Block:
+    it = chain.next_iteration
+    deltas = [
+        Update(source_id=s, iteration=it, delta=np.full(d, tag + s, dtype=np.float64))
+        for s in range(ndeltas)
+    ]
+    w = chain.latest_gradient() + tag
+    blk = Block(
+        data=BlockData(iteration=it, global_w=w, deltas=deltas),
+        prev_hash=chain.latest_hash(),
+        stake_map=chain.latest_stake_map(),
+    )
+    return blk.seal()
+
+
+def test_genesis_deterministic():
+    a = genesis_block(16, 4, 10)
+    b = genesis_block(16, 4, 10)
+    assert a.hash == b.hash
+    assert a.iteration == -1
+    assert np.all(a.data.global_w == 0)
+    assert a.stake_map == {0: 10, 1: 10, 2: 10, 3: 10}
+
+
+def test_hash_covers_contents():
+    g = genesis_block(8, 2, 10)
+    h0 = g.compute_hash()
+    g.data.global_w[0] = 5.0
+    assert g.compute_hash() != h0
+    g.data.global_w[0] = 0.0
+    g.stake_map[0] = 11
+    assert g.compute_hash() != h0
+
+
+def test_append_and_invariants():
+    c = Blockchain(num_params=8, num_nodes=4)
+    for _ in range(5):
+        c.add_block(_mk_block(c))
+    assert len(c) == 6
+    assert c.next_iteration == 5
+    c.verify()
+
+
+def test_append_rejects_bad_iteration_and_hash():
+    c = Blockchain(num_params=8, num_nodes=4)
+    blk = _mk_block(c)
+    blk.data.iteration += 1
+    blk.seal()
+    with pytest.raises(ChainInvariantError):
+        c.add_block(blk)
+    blk2 = _mk_block(c)
+    blk2.hash = b"\x00" * 32  # tampered seal
+    with pytest.raises(ChainInvariantError):
+        c.add_block(blk2)
+
+
+def test_consider_block_same_height_quality():
+    # non-empty beats empty at the same height (ref: honest.go:631-653)
+    c = Blockchain(num_params=8, num_nodes=4)
+    prev = c.latest_hash()
+    empty = Block(
+        data=BlockData(iteration=0, global_w=c.latest_gradient()),
+        prev_hash=prev, stake_map=c.latest_stake_map(),
+    ).seal()
+    assert c.consider_block(empty)
+    assert c.latest.is_empty()
+    full = _mk_block_at(c, prev)
+    assert c.consider_block(full)
+    assert not c.latest.is_empty()
+    # a worse (empty) block cannot displace the full one
+    assert not c.consider_block(empty)
+    c.verify()
+
+
+def _mk_block_at(chain: Blockchain, prev_hash: bytes) -> Block:
+    it = chain.latest.iteration
+    deltas = [Update(source_id=0, iteration=it, delta=np.ones(8))]
+    return Block(
+        data=BlockData(iteration=it, global_w=chain.latest_gradient() + 1, deltas=deltas),
+        prev_hash=prev_hash, stake_map=chain.latest_stake_map(),
+    ).seal()
+
+
+def test_wrong_prev_hash_rejected():
+    c = Blockchain(num_params=8, num_nodes=4)
+    blk = _mk_block(c)
+    blk.prev_hash = b"\xff" * 32
+    blk.seal()
+    assert not c.consider_block(blk)
+
+
+def test_longest_chain_adoption():
+    a = Blockchain(num_params=8, num_nodes=4)
+    b = Blockchain(num_params=8, num_nodes=4)
+    for _ in range(3):
+        a.add_block(_mk_block(a))
+    assert b.maybe_adopt(a)
+    assert b.dump() == a.dump()
+    assert not a.maybe_adopt(b)
+
+
+def test_chain_equality_oracle_across_replicas():
+    # Two peers applying the same block stream must print identical ledgers
+    # (the localTest.sh oracle, ref: DistSys/localTest.sh:40-96).
+    a = Blockchain(num_params=8, num_nodes=4)
+    b = Blockchain(num_params=8, num_nodes=4)
+    for _ in range(4):
+        blk = _mk_block(a)
+        a.add_block(blk)
+        b.add_block(blk)
+    assert a.dump() == b.dump()
+    # stake map travels in blocks and is adopted on append
+    assert a.latest_stake_map() == b.latest_stake_map()
+
+
+def test_update_canonical_bytes_roundtrip_determinism():
+    u1 = Update(source_id=3, iteration=7, delta=np.arange(5, dtype=np.float64),
+                commitment=b"abc", signatures=[b"s1", b"s2"])
+    u2 = Update(source_id=3, iteration=7, delta=np.arange(5, dtype=np.float64),
+                commitment=b"abc", signatures=[b"s1", b"s2"])
+    assert u1.canonical_bytes() == u2.canonical_bytes()
+    u2.delta = u2.delta + 1e-12
+    assert u1.canonical_bytes() != u2.canonical_bytes()
