@@ -100,7 +100,8 @@ class BiscottiConfig:
     timeouts: Timeouts = field(default_factory=Timeouts)
 
     # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
-    learning_rate: float = 1e-3
+    learning_rate: float = 1e-3  # torch-path SGD lr (used by optimizer-step modes)
+    logreg_alpha: float = 1e-2  # numpy-logreg step size α (ref: logistic_model.py:12)
     momentum: float = 0.75
     weight_decay: float = 1e-3
     grad_clip: float = 100.0
@@ -187,9 +188,9 @@ class BiscottiConfig:
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
-        sample = ns.sample_percent
-        if sample > 1.0:  # reference passes -ns as a percentage (e.g. 70)
-            sample = sample / 100.0
+        # -ns is a percentage on the reference CLI (e.g. 70 ⇒ 70%); always
+        # divide so "-ns 1" means 1%, not 100%
+        sample = ns.sample_percent / 100.0
         return cls(
             node_id=ns.node_id,
             num_nodes=ns.num_nodes,

@@ -1,0 +1,13 @@
+from biscotti_tpu.data.datasets import (
+    DATASETS,
+    DatasetSpec,
+    load_shard,
+    num_classes,
+    num_features,
+    num_params,
+)
+
+__all__ = [
+    "DATASETS", "DatasetSpec", "load_shard",
+    "num_classes", "num_features", "num_params",
+]

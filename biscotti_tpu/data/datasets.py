@@ -1,0 +1,136 @@
+"""Dataset registry and deterministic per-peer shards.
+
+Capability parity with the reference's registry (ref: ML/Pytorch/datasets.py:6-52
+— mnist 784/10, lfw 8742/12, cifar 3072/10, creditcard 24/2) and its per-peer
+`.npy` shard loader with an 80/20 train cut (ref: ML/Pytorch/mnist_dataset.py:16-31).
+
+This environment has zero egress, so shards are *synthesized*: each dataset is a
+fixed mixture of Gaussian class clusters drawn from a dataset-specific threefry
+key. Generation is fully deterministic in (dataset, shard_name), so every peer
+process regenerates bit-identical shards — the property the reference gets from
+shipping `.npy` files, and the chain-equality oracle implicitly relies on.
+
+Poisoned shards (`mnist_bad`, `creditbad`; ref: DistSys/honest.go:102-118) are
+the honest shard with source-class labels flipped to the target class
+(1 → 7 for mnist, ref: ML/Pytorch/client.py:163-172). The attack split
+(`mnist_digit1`) is all-source-class data used for the attack-rate metric.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class DatasetSpec:
+    name: str
+    d_in: int
+    n_classes: int
+    shard_size: int  # samples per peer shard
+    test_size: int
+    attack_source: int = 1  # label-flip source class (1→7 for mnist)
+    attack_target: int = 7
+    cluster_scale: float = 1.0  # intra-class spread
+
+
+DATASETS: Dict[str, DatasetSpec] = {
+    "mnist": DatasetSpec("mnist", 784, 10, 600, 2000),
+    "cifar": DatasetSpec("cifar", 3072, 10, 500, 2000),
+    "lfw": DatasetSpec("lfw", 8742, 12, 200, 1000),
+    "creditcard": DatasetSpec("creditcard", 24, 2, 400, 1000,
+                              attack_source=0, attack_target=1),
+}
+
+
+def _spec(dataset: str) -> DatasetSpec:
+    if dataset not in DATASETS:
+        raise KeyError(f"dataset {dataset!r} not defined; have {sorted(DATASETS)}")
+    return DATASETS[dataset]
+
+
+def num_features(dataset: str) -> int:
+    return _spec(dataset).d_in
+
+
+def num_classes(dataset: str) -> int:
+    return _spec(dataset).n_classes
+
+
+def num_params(dataset: str) -> int:
+    """Softmax-model parameter count d_in·k + k (ref: datasets.py:19-20 —
+    mnist 7850, creditcard 50)."""
+    s = _spec(dataset)
+    return s.d_in * s.n_classes + s.n_classes
+
+
+def _rng(dataset: str, tag: str) -> np.random.Generator:
+    seed = int.from_bytes(
+        hashlib.sha256(f"biscotti_tpu/{dataset}/{tag}".encode()).digest()[:8], "little"
+    )
+    return np.random.default_rng(seed)
+
+
+@lru_cache(maxsize=None)
+def _class_means(dataset: str) -> np.ndarray:
+    """Fixed class-cluster means. Separation 6.0 makes a linear model's
+    reachable test error ≈7% from a few hundred samples — the same band as
+    the reference's real-MNIST finals (BASELINE.md: 0.065–0.113) — while
+    smaller separations drown the signal in 784-dim noise."""
+    s = _spec(dataset)
+    rng = _rng(dataset, "means")
+    means = rng.normal(0.0, 1.0, size=(s.n_classes, s.d_in))
+    return (means / np.linalg.norm(means, axis=1, keepdims=True)).astype(np.float32) * 6.0
+
+
+def _draw(dataset: str, tag: str, n: int) -> Tuple[np.ndarray, np.ndarray]:
+    s = _spec(dataset)
+    rng = _rng(dataset, tag)
+    means = _class_means(dataset)
+    y = rng.integers(0, s.n_classes, size=n)
+    x = means[y] + rng.normal(0.0, s.cluster_scale, size=(n, s.d_in)).astype(np.float32)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+@lru_cache(maxsize=None)
+def load_shard(dataset: str, shard: str) -> Dict[str, np.ndarray]:
+    """Load a named shard, mirroring the reference file names:
+
+      "<dataset><i>"      honest shard of peer i  (ref: mnistN.npy)
+      "<dataset>_bad<i>"  label-flipped shard     (ref: mnist_bad)
+      "<dataset>_test"    shared held-out split
+      "<dataset>_digit1"  attack split (all source-class samples)
+
+    Returns {"x_train","y_train","x_test","y_test"} with an 80/20 cut for
+    per-peer shards (ref: mnist_dataset.py:16-31).
+    """
+    s = _spec(dataset)
+    if shard == f"{dataset}_test":
+        x, y = _draw(dataset, "test", s.test_size)
+        return {"x_train": x, "y_train": y, "x_test": x, "y_test": y}
+    if shard == f"{dataset}_digit1":
+        x, y = _draw(dataset, "attack", s.test_size)
+        keep = y == s.attack_source
+        return {"x_train": x[keep], "y_train": y[keep],
+                "x_test": x[keep], "y_test": y[keep]}
+
+    bad = shard.startswith(f"{dataset}_bad")
+    idx = shard[len(f"{dataset}_bad"):] if bad else shard[len(dataset):]
+    peer = int(idx) if idx else 0
+    x, y = _draw(dataset, f"shard{peer}", s.shard_size)
+    if bad:
+        y = y.copy()
+        y[y == s.attack_source] = s.attack_target  # label flip (ref: honest.go:102-118)
+    cut = int(0.8 * len(x))
+    return {"x_train": x[:cut], "y_train": y[:cut],
+            "x_test": x[cut:], "y_test": y[cut:]}
+
+
+def shard_name(dataset: str, peer_id: int, poisoned: bool) -> str:
+    """Reference naming: top `poison_fraction` of node ids get bad shards
+    (ref: DistSys/main.go:836-845)."""
+    return f"{dataset}_bad{peer_id}" if poisoned else f"{dataset}{peer_id}"

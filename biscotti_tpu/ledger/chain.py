@@ -91,8 +91,9 @@ class Blockchain:
         caller's problem (the runtime parks them, ref: main.go:1300-1320).
         """
         if blk.iteration == self.latest.iteration + 1:
-            prev = self.latest.hash
-            if blk.prev_hash != prev:
+            # tampered or unlinked network blocks are ignored, never raised:
+            # a Byzantine peer must not be able to crash an honest one
+            if blk.prev_hash != self.latest.hash or blk.hash != blk.compute_hash():
                 return False
             self.add_block(blk)
             return True
@@ -106,11 +107,21 @@ class Blockchain:
         return False
 
     def maybe_adopt(self, other: "Blockchain") -> bool:
-        """Longest-chain adoption on (re)join (ref: main.go:1001-1013)."""
-        if len(other.blocks) > len(self.blocks):
-            self.blocks = list(other.blocks)
-            return True
-        return False
+        """Longest-chain adoption on (re)join (ref: main.go:1001-1013).
+
+        The candidate chain is structurally verified first so a Byzantine
+        peer cannot hand a late joiner forged hashes or a fabricated stake
+        map. Blocks are shared by reference — they are immutable once
+        sealed — but the list itself is copied.
+        """
+        if len(other.blocks) <= len(self.blocks):
+            return False
+        try:
+            other.verify()
+        except ChainInvariantError:
+            return False
+        self.blocks = list(other.blocks)
+        return True
 
     # ------------------------------------------------------------- oracle
 

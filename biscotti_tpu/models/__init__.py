@@ -1,0 +1,4 @@
+from biscotti_tpu.models.base import Model, make_model
+from biscotti_tpu.models.zoo import MODELS, model_for_dataset
+
+__all__ = ["Model", "make_model", "MODELS", "model_for_dataset"]

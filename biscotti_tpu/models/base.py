@@ -1,0 +1,75 @@
+"""Functional model abstraction.
+
+The reference moves *flat* float vectors across every boundary (Go ⇄ Python,
+peer ⇄ peer): models expose `reshape` to unflatten (ref:
+ML/Pytorch/softmax_model.py:20-24, mnist_cnn_model.py:43-67). We keep that
+contract — the framework's wire unit is a flat vector — but derive
+flatten/unflatten automatically from the param pytree with
+`jax.flatten_util.ravel_pytree`, so every model gets it for free and layouts
+can never drift from the init.
+
+All apply/loss functions are pure and jittable; `vmap` over the params axis
+is how N peers train in one XLA program (see parallel/sim.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+@dataclass(frozen=True)
+class Model:
+    name: str
+    d_in: int
+    n_classes: int
+    init: Callable[[jax.Array], Any]  # key -> params pytree
+    apply: Callable[[Any, jax.Array], jax.Array]  # (params, x[B,d_in]) -> logits
+    loss: Callable[[Any, jax.Array, jax.Array], jax.Array]  # mean scalar loss
+    num_params: int
+    unravel: Callable[[jax.Array], Any] = field(repr=False, default=None)
+
+    def flat_init(self, key: jax.Array) -> jax.Array:
+        return ravel_pytree(self.init(key))[0].astype(jnp.float32)
+
+    def flatten(self, params: Any) -> jax.Array:
+        return ravel_pytree(params)[0].astype(jnp.float32)
+
+    def apply_flat(self, flat_w: jax.Array, x: jax.Array) -> jax.Array:
+        return self.apply(self.unravel(flat_w), x)
+
+    def loss_flat(self, flat_w: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+        return self.loss(self.unravel(flat_w), x, y)
+
+    def error_flat(self, flat_w: jax.Array, x: jax.Array, y: jax.Array) -> jax.Array:
+        """1 − accuracy (ref: ML/Pytorch/client.py:136-160)."""
+        pred = jnp.argmax(self.apply_flat(flat_w, x), axis=-1)
+        return jnp.mean((pred != y).astype(jnp.float32))
+
+
+def make_model(name, d_in, n_classes, init, apply, loss) -> Model:
+    """Bind flatten/unflatten to a canonical zero-key init layout."""
+    example = init(jax.random.PRNGKey(0))
+    flat, unravel = ravel_pytree(example)
+    return Model(
+        name=name, d_in=d_in, n_classes=n_classes, init=init, apply=apply,
+        loss=loss, num_params=int(flat.size), unravel=unravel,
+    )
+
+
+def cross_entropy(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Mean CE over the batch (ref: nn.CrossEntropyLoss, client.py:29)."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=-1))
+
+
+def multiclass_hinge(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Crammer–Singer hinge for the SVM model (ref: ML/Pytorch/svm_model.py)."""
+    yi = jnp.take_along_axis(logits, y[:, None].astype(jnp.int32), axis=-1)
+    margins = jnp.maximum(0.0, 1.0 + logits - yi)
+    margins = margins.at[jnp.arange(logits.shape[0]), y].set(0.0)
+    return jnp.mean(jnp.sum(margins, axis=-1))

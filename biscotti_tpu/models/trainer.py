@@ -1,0 +1,187 @@
+"""Per-peer trainer — the framework's internal replacement for the reference's
+embedded-Python bridge API (init / privateFun / getNoise / roni / getTestErr /
+get17AttackRate; ref: ML/Pytorch/client_obj.py, DistSys/honest.go:204-324).
+
+Two step rules, matching the two reference stacks:
+
+  * torch-parity ("grad"): delta = −clip₁₀₀(∇CE(w; minibatch))
+    (ref: client.py:38-65 — backward + clip_grad_norm(100), no optimizer.step,
+    privateFun returns −grad, client_obj.py:73-77)
+  * logreg-parity ("sgd"): delta = −α·∇f(w; minibatch), α=1e-2, f the
+    L2-regularized logistic loss (ref: logistic_model.py:113-140)
+
+Everything below `Trainer.__init__` is jitted XLA; the minibatch draw is a
+threefry `random.choice` folded from (seed, iteration) so peers are
+deterministic given their id — required by the chain-equality oracle.
+
+`local_step_fn` is exposed standalone (pure) so parallel/sim.py can vmap the
+identical computation over a stacked peer axis.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from biscotti_tpu.data import datasets as ds
+from biscotti_tpu.models.base import Model
+from biscotti_tpu.models.zoo import model_for_dataset
+from biscotti_tpu.ops import dp_noise
+
+GRAD_CLIP = 100.0  # default, ref: client.py:56; overridable via cfg.grad_clip
+LOGREG_ALPHA = 1e-2  # ref: logistic_model.py:12; overridable via cfg.learning_rate
+EXPECTED_ITERS = 100  # default DP presample depth (ref: client_obj.py:17)
+
+
+def clip_by_global_norm(g: jax.Array, max_norm: float) -> jax.Array:
+    n = jnp.linalg.norm(g)
+    return g * jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+
+
+def local_step_fn(model: Model, mode: str = "grad", clip: float = GRAD_CLIP,
+                  alpha: float = LOGREG_ALPHA) -> Callable:
+    """Pure per-peer update rule: (flat_w, x_batch, y_batch) -> flat_delta."""
+    if mode == "grad":
+
+        def step(flat_w, x, y):
+            g = jax.grad(model.loss_flat)(flat_w, x, y)
+            return -clip_by_global_norm(g, clip)
+
+    elif mode == "sgd":
+
+        def step(flat_w, x, y):
+            # model.loss is already (1/B)Σ data + λ/2‖w‖², whose gradient is
+            # the reference's (1/B)·Xᵀres + λw (ref: logistic_model.py:100-106)
+            g = jax.grad(model.loss_flat)(flat_w, x, y)
+            return -alpha * g
+
+    else:
+        raise ValueError(f"unknown step mode {mode!r}")
+    return step
+
+
+def sample_batch(key: jax.Array, n: int, batch_size: int) -> jax.Array:
+    """Minibatch without replacement (ref: logistic_model.py:121-125,
+    torch DataLoader shuffle)."""
+    return jax.random.choice(key, n, (min(batch_size, n),), replace=False)
+
+
+class Trainer:
+    """One peer's ML state: shard on device, jitted step/metric functions."""
+
+    def __init__(self, dataset: str, shard: str, cfg=None, model: Model = None,
+                 seed: int = None):
+        from biscotti_tpu.config import BiscottiConfig
+
+        self.cfg = cfg or BiscottiConfig(dataset=dataset)
+        self.dataset = dataset
+        self.model = model or model_for_dataset(dataset)
+        self.mode = "sgd" if self.model.name == "logreg" else "grad"
+        self.batch_size = self.cfg.batch_size
+        # Every stream is keyed on (config seed, shard identity) so peers
+        # built with default args still get independent DP noise and batch
+        # draws — the shard name is the peer identity.
+        if seed is None:
+            seed = zlib.crc32(shard.encode())
+        self.seed = seed
+
+        shard_data = ds.load_shard(dataset, shard)
+        test = ds.load_shard(dataset, f"{dataset}_test")
+        attack = ds.load_shard(dataset, f"{dataset}_digit1")
+        self.x_train = jnp.asarray(shard_data["x_train"])
+        self.y_train = jnp.asarray(shard_data["y_train"])
+        self.x_test = jnp.asarray(test["x_test"])
+        self.y_test = jnp.asarray(test["y_test"])
+        self.x_attack = jnp.asarray(attack["x_test"])
+        self.y_attack = jnp.asarray(attack["y_test"])
+
+        self.num_params = self.model.num_params
+        base = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.seed)
+        noise_key, batch_key = jax.random.split(base)
+        self.noise_samples = dp_noise.presample(
+            noise_key,
+            self.cfg.epsilon if self.cfg.noising or self.cfg.dp_in_model else 0.0,
+            self.cfg.delta, self.batch_size, self.cfg.noise_presample_iters,
+            self.num_params,
+        )
+
+        alpha = self.cfg.logreg_alpha
+        step = local_step_fn(self.model, self.mode, clip=self.cfg.grad_clip,
+                             alpha=alpha)
+
+        @jax.jit
+        def _private_fun(flat_w, it):
+            k = jax.random.fold_in(batch_key, it)
+            idx = sample_batch(k, self.x_train.shape[0], self.batch_size)
+            return step(flat_w, self.x_train[idx], self.y_train[idx])
+
+        @jax.jit
+        def _err(flat_w, x, y):
+            return self.model.error_flat(flat_w, x, y)
+
+        @jax.jit
+        def _roni(flat_w, delta):
+            # score = err(w+δ) − err(w) on the local train split
+            # (ref: client_obj.py:100-112; rejected if > 0.02, main.go:203-231)
+            before = self.model.error_flat(flat_w, self.x_train, self.y_train)
+            after = self.model.error_flat(flat_w + delta, self.x_train, self.y_train)
+            return after - before
+
+        self._private_fun = _private_fun
+        self._err = _err
+        self._roni = _roni
+
+    # ---- reference bridge API (honest.go:204-324 surface) ----
+
+    def init_weights(self) -> np.ndarray:
+        """Zero init, matching the genesis global model (ref: block.go:46-52)."""
+        return np.zeros(self.num_params, dtype=np.float64)
+
+    def private_fun(self, flat_w: np.ndarray, iteration: int) -> np.ndarray:
+        return np.asarray(
+            self._private_fun(jnp.asarray(flat_w, jnp.float32), iteration),
+            dtype=np.float64,
+        )
+
+    def get_noise(self, iteration: int) -> np.ndarray:
+        alpha = self.cfg.logreg_alpha if self.mode == "sgd" else 1.0
+        return np.asarray(
+            dp_noise.noise_at(self.noise_samples, iteration, self.batch_size, alpha),
+            dtype=np.float64,
+        )
+
+    def train_error(self, flat_w: np.ndarray) -> float:
+        return float(self._err(jnp.asarray(flat_w, jnp.float32),
+                               self.x_train, self.y_train))
+
+    def test_error(self, flat_w: np.ndarray) -> float:
+        return float(self._err(jnp.asarray(flat_w, jnp.float32),
+                               self.x_test, self.y_test))
+
+    def attack_rate(self, flat_w: np.ndarray) -> float:
+        """Reference-faithful metric: 1 − accuracy on the attack-source split
+        (ref: client.py:163-172 get17AttackRate is literally
+        1 − accuracy_score on the digit-1 loader). Counts *any*
+        misclassification of source-class samples."""
+        return float(self._err(jnp.asarray(flat_w, jnp.float32),
+                               self.x_attack, self.y_attack))
+
+    def attack_success_rate(self, flat_w: np.ndarray) -> float:
+        """Stricter 1→7 metric: fraction of attack-source samples predicted
+        as exactly the attack target class (not inflated by benign
+        confusion the way `attack_rate` can be)."""
+        from biscotti_tpu.data.datasets import DATASETS
+
+        target = DATASETS[self.dataset].attack_target
+        logits = self.model.apply_flat(jnp.asarray(flat_w, jnp.float32),
+                                       self.x_attack)
+        pred = jnp.argmax(logits, axis=-1)
+        return float(jnp.mean((pred == target).astype(jnp.float32)))
+
+    def roni(self, flat_w: np.ndarray, delta: np.ndarray) -> float:
+        return float(self._roni(jnp.asarray(flat_w, jnp.float32),
+                                jnp.asarray(delta, jnp.float32)))

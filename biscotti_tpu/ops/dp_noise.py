@@ -1,0 +1,47 @@
+"""Differential-privacy noise presampling (Abadi-16 style).
+
+Parity with the reference (ref: ML/Pytorch/client_obj.py:59-67,
+ML/code/logistic_model.py:79-87):
+
+    σ = √(2·ln(1.25/δ)) / ε
+    samples = Σ_batch σ·N(0,1)[batch, iters, d]      (presampled once)
+    noise(i) = (−1/batch)·samples[i mod iters]        (torch path)
+    noise(i) = (−α/batch)·samples[i mod iters]        (logreg path, α folded by caller)
+
+Summing `batch` iid Gaussians equals one draw with std σ·√batch, so we sample
+the reduced tensor directly — same distribution, 1/batch the HBM traffic.
+A threefry key (not global RNG) keeps every peer's stream independent and
+reproducible.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def sigma_for(epsilon: float, delta: float = 1e-5) -> float:
+    if epsilon <= 0:
+        return 0.0
+    return math.sqrt(2.0 * math.log(1.25 / delta)) / epsilon
+
+
+def presample(key: jax.Array, epsilon: float, delta: float, batch_size: int,
+              expected_iters: int, d: int) -> jax.Array:
+    """Return samples[iters, d] ~ Σ_batch σ·N(0,1) (ref: client_obj.py:63-66)."""
+    s = sigma_for(epsilon, delta)
+    if s == 0.0:
+        return jnp.zeros((expected_iters, d), jnp.float32)
+    return s * math.sqrt(batch_size) * jax.random.normal(
+        key, (expected_iters, d), jnp.float32
+    )
+
+
+def noise_at(samples: jax.Array, iteration, batch_size: int,
+             alpha: float = 1.0) -> jax.Array:
+    """noise(i) = (−α/batch)·samples[i mod iters] (ref: client_obj.py:97-98,
+    logistic_model.py:108-109)."""
+    i = jnp.asarray(iteration) % samples.shape[0]
+    return (-alpha / batch_size) * samples[i]

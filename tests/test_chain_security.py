@@ -1,0 +1,58 @@
+"""Byzantine-input hardening tests for the ledger: tampered blocks and
+forged chains must be ignored, never crash an honest peer."""
+
+import numpy as np
+
+from biscotti_tpu.ledger import Block, BlockData, Blockchain, Update
+
+
+def _block(chain, ndeltas=1):
+    it = chain.next_iteration
+    return Block(
+        data=BlockData(iteration=it, global_w=chain.latest_gradient() + 1,
+                       deltas=[Update(s, it, np.ones(4)) for s in range(ndeltas)]),
+        prev_hash=chain.latest_hash(), stake_map=chain.latest_stake_map(),
+    ).seal()
+
+
+def test_tampered_next_height_block_ignored_not_raised():
+    c = Blockchain(num_params=4, num_nodes=2)
+    blk = _block(c)
+    blk.hash = b"\xab" * 32  # forged seal
+    assert c.consider_block(blk) is False
+    assert len(c) == 1
+
+
+def test_tampered_same_height_replacement_ignored():
+    c = Blockchain(num_params=4, num_nodes=2)
+    empty = Block(data=BlockData(iteration=0, global_w=c.latest_gradient()),
+                  prev_hash=c.latest_hash(), stake_map=c.latest_stake_map()).seal()
+    c.consider_block(empty)
+    forged = _mk_forged_full(c)
+    assert c.consider_block(forged) is False
+    c.verify()
+
+
+def _mk_forged_full(chain):
+    blk = Block(
+        data=BlockData(iteration=0, global_w=np.ones(4),
+                       deltas=[Update(0, 0, np.ones(4))]),
+        prev_hash=chain.blocks[-2].hash, stake_map=chain.latest_stake_map(),
+    ).seal()
+    blk.data.global_w = np.full(4, 666.0)  # mutate after seal
+    return blk
+
+
+def test_forged_longer_chain_not_adopted():
+    honest = Blockchain(num_params=4, num_nodes=2)
+    evil = Blockchain(num_params=4, num_nodes=2)
+    for _ in range(3):
+        evil.add_block(_block(evil))
+    evil.blocks[2].stake_map = {0: 10**9, 1: 0}  # inflate stake post-seal
+    assert honest.maybe_adopt(evil) is False
+    assert len(honest) == 1
+    # a valid longer chain is still adopted
+    good = Blockchain(num_params=4, num_nodes=2)
+    for _ in range(3):
+        good.add_block(_block(good))
+    assert honest.maybe_adopt(good) is True

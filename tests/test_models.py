@@ -1,0 +1,175 @@
+"""ML-core tests: registry parity, model shapes, step-rule semantics,
+trainer convergence on the synthetic shards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig
+from biscotti_tpu.data import datasets as ds
+from biscotti_tpu.models.base import cross_entropy
+from biscotti_tpu.models.trainer import Trainer, local_step_fn
+from biscotti_tpu.models.zoo import (
+    cifar_cnn_model, logreg_model, mnist_cnn_model, model_for_dataset,
+    softmax_model, svm_model,
+)
+from biscotti_tpu.ops import dp_noise
+
+
+def test_registry_parity():
+    # ref: ML/Pytorch/datasets.py:19-20 — mnist 7850, creditcard 50
+    assert ds.num_params("mnist") == 7850
+    assert ds.num_params("creditcard") == 50
+    assert ds.num_features("lfw") == 8742 and ds.num_classes("lfw") == 12
+    assert ds.num_features("cifar") == 3072
+    with pytest.raises(KeyError):
+        ds.num_params("nope")
+
+
+def test_shards_deterministic_and_disjoint():
+    a = ds.load_shard("mnist", "mnist3")
+    b = ds.load_shard.__wrapped__("mnist", "mnist3")  # bypass cache
+    np.testing.assert_array_equal(a["x_train"], b["x_train"])
+    c = ds.load_shard("mnist", "mnist4")
+    assert not np.array_equal(a["x_train"][:10], c["x_train"][:10])
+    # 80/20 cut (ref: mnist_dataset.py:16-31)
+    spec = ds.DATASETS["mnist"]
+    assert len(a["x_train"]) == int(0.8 * spec.shard_size)
+
+
+def test_bad_shard_label_flip():
+    good = ds.load_shard("mnist", "mnist2")
+    bad = ds.load_shard("mnist", "mnist_bad2")
+    assert (good["y_train"] == 1).sum() > 0
+    assert (bad["y_train"] == 1).sum() == 0  # all 1s flipped to 7
+    flipped = good["y_train"] == 1
+    assert np.all(bad["y_train"][flipped] == 7)
+    np.testing.assert_array_equal(good["x_train"], bad["x_train"])
+
+
+def test_model_param_counts():
+    assert softmax_model(784, 10).num_params == 7850
+    assert logreg_model(24).num_params == 25  # bias feature appended
+    assert svm_model(24, 2).num_params == 50
+    m = mnist_cnn_model()
+    # ref: mnist_cnn_model.py:43-55 — 16·1·5·5 + 16 + 10·16·32·32 + 10
+    assert m.num_params == 16 * 25 + 16 + 10 * 16 * 32 * 32 + 10
+    cifar_cnn_model()  # shape-checks at trace time
+
+
+def test_grad_step_is_neg_clipped_gradient():
+    m = softmax_model(8, 3)
+    step = local_step_fn(m, "grad")
+    k = jax.random.PRNGKey(1)
+    w = m.flat_init(k) * 100.0  # big weights -> big grad, tests clipping
+    x = jax.random.normal(k, (16, 8)) * 50.0
+    y = jnp.zeros((16,), jnp.int32)
+    delta = step(w, x, y)
+    g = jax.grad(m.loss_flat)(w, x, y)
+    assert float(jnp.linalg.norm(delta)) <= 100.0 + 1e-3
+    # direction preserved
+    cos = jnp.dot(delta, -g) / (jnp.linalg.norm(delta) * jnp.linalg.norm(g))
+    assert float(cos) > 0.999
+
+
+def test_logreg_matches_reference_formula():
+    # delta = −α((1/B)Xᵀres + λw) (ref: logistic_model.py:100-106,113-140 —
+    # data term batch-averaged, L2 term NOT)
+    m = logreg_model(4, lammy=0.01)
+    step = local_step_fn(m, "sgd")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(6, 4)).astype(np.float32)
+    y01 = np.array([0, 1, 1, 0, 1, 0], dtype=np.int32)
+    w = rng.normal(size=5).astype(np.float32)
+    Xb = np.concatenate([X, np.ones((6, 1), np.float32)], axis=1)
+    ypm = 2.0 * y01 - 1.0
+    yXw = ypm * (Xb @ w)
+    res = -ypm / np.exp(np.logaddexp(0, yXw))
+    g_ref = (1 / 6) * Xb.T @ res + 0.01 * w
+    delta = np.asarray(step(jnp.asarray(w), jnp.asarray(X), jnp.asarray(y01)))
+    np.testing.assert_allclose(delta, -1e-2 * g_ref, rtol=1e-4, atol=1e-6)
+
+
+def test_all_zoo_models_apply():
+    # every model must trace and produce (B, k) logits — catches layer-size
+    # arithmetic bugs that init alone cannot (e.g. conv/pool flatten dims)
+    from biscotti_tpu.models.zoo import MODELS
+
+    pairs = {"softmax": "mnist", "logreg": "creditcard", "svm": "creditcard",
+             "mnist_cnn": "mnist", "cifar_cnn": "cifar", "lfw_cnn": "lfw"}
+    for name, dataset in pairs.items():
+        m = MODELS[name](dataset)
+        x = jnp.zeros((2, m.d_in), jnp.float32)
+        y = jnp.zeros((2,), jnp.int32)
+        logits = m.apply_flat(m.flat_init(jax.random.PRNGKey(0)), x)
+        assert logits.shape == (2, m.n_classes), name
+        assert float(m.loss_flat(m.flat_init(jax.random.PRNGKey(0)), x, y)) >= 0.0
+
+
+def test_peers_get_independent_noise_by_default():
+    cfg = BiscottiConfig(dataset="mnist", epsilon=1.0, batch_size=8)
+    a = Trainer("mnist", "mnist0", cfg=cfg)
+    b = Trainer("mnist", "mnist1", cfg=cfg)
+    assert not np.allclose(a.get_noise(0), b.get_noise(0))
+    # same identity → same stream (determinism for the oracle)
+    a2 = Trainer("mnist", "mnist0", cfg=cfg)
+    np.testing.assert_array_equal(a.get_noise(3), a2.get_noise(3))
+
+
+def test_dp_noise_stats_and_schedule():
+    key = jax.random.PRNGKey(0)
+    s = dp_noise.presample(key, epsilon=1.0, delta=1e-5, batch_size=10,
+                           expected_iters=50, d=4000)
+    sigma = dp_noise.sigma_for(1.0, 1e-5)
+    emp = float(jnp.std(s))
+    assert abs(emp - sigma * np.sqrt(10)) / (sigma * np.sqrt(10)) < 0.05
+    n0 = dp_noise.noise_at(s, 0, 10)
+    n50 = dp_noise.noise_at(s, 50, 10)  # wraps mod expected_iters
+    np.testing.assert_array_equal(np.asarray(n0), np.asarray(n50))
+    z = dp_noise.presample(key, 0.0, 1e-5, 10, 5, 7)
+    assert float(jnp.abs(z).max()) == 0.0
+
+
+def test_trainer_mnist_converges():
+    cfg = BiscottiConfig(dataset="mnist", epsilon=0.0, noising=False, batch_size=64)
+    t = Trainer("mnist", "mnist0", cfg=cfg)
+    w = t.init_weights()
+    e0 = t.test_error(w)
+    for it in range(60):
+        w = w + t.private_fun(w, it)
+    e1 = t.test_error(w)
+    assert e0 > 0.8  # zero weights ≈ random
+    assert e1 < 0.2, f"did not converge: {e0} -> {e1}"
+
+
+def test_trainer_creditcard_logreg_converges():
+    cfg = BiscottiConfig(dataset="creditcard", epsilon=0.0, noising=False,
+                         batch_size=32)
+    t = Trainer("creditcard", "creditcard0", cfg=cfg)
+    w = t.init_weights()
+    for it in range(300):
+        w = w + t.private_fun(w, it)
+    assert t.train_error(w) < 0.15
+
+
+def test_roni_scores_poisoned_vs_honest():
+    cfg = BiscottiConfig(dataset="mnist", epsilon=0.0, noising=False, batch_size=64)
+    t = Trainer("mnist", "mnist0", cfg=cfg)
+    w = t.init_weights()
+    for it in range(40):
+        w = w + t.private_fun(w, it)
+    honest_delta = t.private_fun(w, 99)
+    garbage = -50.0 * honest_delta  # a harmful update
+    assert t.roni(w, honest_delta) <= 0.02
+    assert t.roni(w, garbage) > t.roni(w, honest_delta)
+
+
+def test_attack_rate_metric():
+    cfg = BiscottiConfig(dataset="mnist", epsilon=0.0, noising=False, batch_size=64)
+    t = Trainer("mnist", "mnist_bad0", cfg=cfg)
+    w = t.init_weights()
+    for it in range(80):
+        w = w + t.private_fun(w, it)
+    # training only on poisoned data should push 1s toward 7: high attack rate
+    assert t.attack_rate(w) > 0.5
