@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Headline benchmark: wall-clock per training iteration, 100-peer MNIST
+softmax with Krum verification and DP noising — the reference's flagship
+configuration (BASELINE.md row 1: 38.2–42.0 s/iteration on 100 Azure
+VMs-worth of CPU processes; north star ≲4 s/iteration).
+
+One full iteration here = every contributor's local SGD step + DP noise +
+Krum filtering over the round's updates + aggregation + stake update +
+convergence metric, all in one jitted XLA program on the TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline = reference_seconds / our_seconds (higher is better; ≥10 is the
+north-star).
+"""
+
+import json
+import sys
+import time
+
+BASELINE_S_PER_ITER = 38.2  # BASELINE.md: Biscotti wall-clock/iteration, low end
+
+
+def main():
+    import jax
+
+    from biscotti_tpu.config import BiscottiConfig, Defense
+    from biscotti_tpu.parallel.sim import Simulator
+
+    cfg = BiscottiConfig(
+        dataset="mnist",
+        num_nodes=100,
+        batch_size=10,  # ref batch size (client_obj __main__, honest.go)
+        epsilon=1.0,
+        noising=True,
+        verification=True,
+        defense=Defense.KRUM,
+        sample_percent=0.70,
+        num_verifiers=3,
+        num_miners=3,
+        seed=0,
+    )
+    sim = Simulator(cfg)
+    w, stake = sim.init_state()
+
+    # warm-up: compile + first dispatch
+    for it in range(3):
+        w, stake, mask, err = sim.round_step(w, stake, it)
+    jax.block_until_ready(w)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for it in range(3, 3 + iters):
+        w, stake, mask, err = sim.round_step(w, stake, it)
+    jax.block_until_ready(w)
+    dt = (time.perf_counter() - t0) / iters
+
+    out = {
+        "metric": "wall-clock/iteration, 100-peer MNIST softmax + Krum + DP (ref: 38.2s)",
+        "value": round(dt, 6),
+        "unit": "s/iter",
+        "vs_baseline": round(BASELINE_S_PER_ITER / dt, 2),
+        "final_error": round(float(err), 4),
+        "accepted_per_round": int(mask.sum()),
+        "device": str(jax.devices()[0]),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

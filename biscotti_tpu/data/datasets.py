@@ -10,10 +10,12 @@ key. Generation is fully deterministic in (dataset, shard_name), so every peer
 process regenerates bit-identical shards — the property the reference gets from
 shipping `.npy` files, and the chain-equality oracle implicitly relies on.
 
-Poisoned shards (`mnist_bad`, `creditbad`; ref: DistSys/honest.go:102-118) are
-the honest shard with source-class labels flipped to the target class
-(1 → 7 for mnist, ref: ML/Pytorch/client.py:163-172). The attack split
-(`mnist_digit1`) is all-source-class data used for the attack-rate metric.
+Poisoned shards are the honest shard with source-class labels flipped to the
+target class (1 → 7 for mnist, ref: ML/Pytorch/client.py:163-172; the
+reference calls these `mnist_bad` / `creditbad`, here uniformly
+`<dataset>_bad<i>` — use `shard_name()` to construct names). The attack
+split (`<dataset>_digit1`) is all-source-class data for the attack-rate
+metric. Malformed shard names raise instead of silently resolving.
 """
 
 from __future__ import annotations
@@ -62,8 +64,15 @@ def num_classes(dataset: str) -> int:
 
 
 def num_params(dataset: str) -> int:
-    """Softmax-model parameter count d_in·k + k (ref: datasets.py:19-20 —
-    mnist 7850, creditcard 50)."""
+    """Reference-registry parity value: the *softmax* parameter count
+    d_in·k + k (ref: datasets.py:19-20 — mnist 7850, creditcard 50).
+
+    NOTE: the authoritative wire size for any run is
+    `model_for_dataset(ds).num_params` — e.g. creditcard's default model is
+    the numpy-parity logreg (25 params), while this registry reports the
+    softmax value 50, exactly as the reference registry does even though
+    its creditcard runs use the d=25 logreg stack. Size buffers from the
+    model, not from here."""
     s = _spec(dataset)
     return s.d_in * s.n_classes + s.n_classes
 
@@ -119,7 +128,12 @@ def load_shard(dataset: str, shard: str) -> Dict[str, np.ndarray]:
                 "x_test": x[keep], "y_test": y[keep]}
 
     bad = shard.startswith(f"{dataset}_bad")
-    idx = shard[len(f"{dataset}_bad"):] if bad else shard[len(dataset):]
+    prefix = f"{dataset}_bad" if bad else dataset
+    if not shard.startswith(prefix):
+        raise ValueError(f"shard {shard!r} does not belong to dataset {dataset!r}")
+    idx = shard[len(prefix):]
+    if idx and not idx.isdigit():
+        raise ValueError(f"malformed shard name {shard!r} for dataset {dataset!r}")
     peer = int(idx) if idx else 0
     x, y = _draw(dataset, f"shard{peer}", s.shard_size)
     if bad:

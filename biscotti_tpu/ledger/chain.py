@@ -16,6 +16,7 @@ and all dumps must be byte-identical (ref: DistSys/localTest.sh:40-96).
 
 from __future__ import annotations
 
+import copy
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -93,9 +94,10 @@ class Blockchain:
         if blk.iteration == self.latest.iteration + 1:
             # tampered or unlinked network blocks are ignored, never raised:
             # a Byzantine peer must not be able to crash an honest one
-            if blk.prev_hash != self.latest.hash or blk.hash != blk.compute_hash():
+            try:
+                self.add_block(blk)
+            except ChainInvariantError:
                 return False
-            self.add_block(blk)
             return True
         if blk.iteration == self.latest.iteration and len(self.blocks) >= 2:
             if blk.hash != blk.compute_hash():
@@ -109,10 +111,11 @@ class Blockchain:
     def maybe_adopt(self, other: "Blockchain") -> bool:
         """Longest-chain adoption on (re)join (ref: main.go:1001-1013).
 
-        The candidate chain is structurally verified first so a Byzantine
-        peer cannot hand a late joiner forged hashes or a fabricated stake
-        map. Blocks are shared by reference — they are immutable once
-        sealed — but the list itself is copied.
+        Guards against Byzantine suppliers: the candidate must (a) verify
+        structurally, (b) extend this chain's existing prefix — a longer but
+        *divergent* history (e.g. a re-sealed forgery from a different
+        genesis or a rewritten past block) is refused — and (c) blocks are
+        deep-copied so the supplier cannot mutate our chain afterwards.
         """
         if len(other.blocks) <= len(self.blocks):
             return False
@@ -120,7 +123,10 @@ class Blockchain:
             other.verify()
         except ChainInvariantError:
             return False
-        self.blocks = list(other.blocks)
+        for mine, theirs in zip(self.blocks, other.blocks):
+            if mine.hash != theirs.hash:
+                return False
+        self.blocks = copy.deepcopy(other.blocks)
         return True
 
     # ------------------------------------------------------------- oracle

@@ -33,8 +33,7 @@ from biscotti_tpu.models.zoo import model_for_dataset
 from biscotti_tpu.ops import dp_noise
 
 GRAD_CLIP = 100.0  # default, ref: client.py:56; overridable via cfg.grad_clip
-LOGREG_ALPHA = 1e-2  # ref: logistic_model.py:12; overridable via cfg.learning_rate
-EXPECTED_ITERS = 100  # default DP presample depth (ref: client_obj.py:17)
+LOGREG_ALPHA = 1e-2  # default α, ref: logistic_model.py:12; overridable via cfg.logreg_alpha
 
 
 def clip_by_global_norm(g: jax.Array, max_norm: float) -> jax.Array:

@@ -1,0 +1,3 @@
+from biscotti_tpu.parallel.sim import Simulator
+
+__all__ = ["Simulator"]

@@ -43,6 +43,40 @@ def _mk_forged_full(chain):
     return blk
 
 
+def test_resealed_divergent_chain_not_adopted():
+    # a properly-sealed chain from a divergent history (different deltas ->
+    # different hashes at overlapping heights) must be refused even though
+    # verify() passes on it
+    honest = Blockchain(num_params=4, num_nodes=2)
+    honest.add_block(_block(honest, ndeltas=1))
+    evil = Blockchain(num_params=4, num_nodes=2)
+    for _ in range(3):
+        evil.add_block(_block(evil, ndeltas=2))  # diverges at height 0
+    evil.verify()  # structurally fine
+    assert honest.maybe_adopt(evil) is False
+
+
+def test_adopted_blocks_are_isolated_copies():
+    a = Blockchain(num_params=4, num_nodes=2)
+    for _ in range(2):
+        a.add_block(_block(a))
+    b = Blockchain(num_params=4, num_nodes=2)
+    assert b.maybe_adopt(a)
+    a.blocks[1].data.global_w[:] = 666.0  # supplier mutates after handoff
+    assert not np.any(b.blocks[1].data.global_w == 666.0)
+    b.verify()
+
+
+def test_malformed_shard_names_raise():
+    import pytest
+    from biscotti_tpu.data.datasets import load_shard
+
+    with pytest.raises(ValueError):
+        load_shard("creditcard", "creditbad0")  # reference alias not silently clean
+    with pytest.raises(ValueError):
+        load_shard("mnist", "bogus7")
+
+
 def test_forged_longer_chain_not_adopted():
     honest = Blockchain(num_params=4, num_nodes=2)
     evil = Blockchain(num_params=4, num_nodes=2)

@@ -1,0 +1,95 @@
+"""Defense-kernel tests: Krum XLA kernel vs a literal numpy transcription of
+the reference math, RONI batch scoring, poisoner-rejection behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from biscotti_tpu.ops.krum import (
+    collusion_accept_override, default_num_adversaries, krum_accept_mask,
+    krum_scores, krum_select, pairwise_sq_dists,
+)
+from biscotti_tpu.ops.roni import make_roni_kernel, roni_scores
+from biscotti_tpu.models.zoo import softmax_model
+
+
+def _numpy_krum_scores(X, groupsize):
+    # literal transcription of the reference math (client_obj.py:127-143)
+    X = np.asarray(X, dtype=np.float64)
+    dists = (np.sum(X**2, axis=1)[:, None] + np.sum(X**2, axis=1)[None]
+             - 2 * X @ X.T)
+    scores = np.zeros(len(X))
+    for i in range(len(X)):
+        scores[i] = np.sum(np.sort(dists[i])[1:(groupsize - 1)])
+    return scores
+
+
+def test_krum_scores_match_reference_numpy():
+    rng = np.random.default_rng(0)
+    n, d = 20, 64
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    f = default_num_adversaries(n)
+    ours = np.asarray(krum_scores(jnp.asarray(X), f))
+    ref = _numpy_krum_scores(X, n - f)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+def test_krum_accept_set_matches_argpartition():
+    rng = np.random.default_rng(1)
+    n, d = 30, 16
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    f = default_num_adversaries(n)
+    ref_scores = _numpy_krum_scores(X, n - f)
+    ref_idx = set(np.argpartition(ref_scores, n - f)[: n - f])
+    ours = set(np.asarray(krum_select(X, f)).tolist())
+    assert ours == ref_idx
+
+
+def test_krum_rejects_outliers():
+    rng = np.random.default_rng(2)
+    n, d, bad = 40, 128, 12
+    honest = rng.normal(0, 0.1, size=(n - bad, d))
+    poisoned = rng.normal(5.0, 0.1, size=(bad, d))  # far-off cluster
+    X = np.concatenate([honest, poisoned]).astype(np.float32)
+    f = default_num_adversaries(n)
+    mask = np.asarray(krum_accept_mask(jnp.asarray(X), f))
+    assert mask[: n - bad].sum() == n - f  # all accepted are honest
+    assert mask[n - bad:].sum() == 0  # every poisoned update rejected
+
+
+def test_krum_tiny_group_edge():
+    X = np.eye(4, dtype=np.float32)
+    s = np.asarray(krum_scores(jnp.asarray(X), 2))  # groupsize 2 -> k=0
+    assert np.all(s == 0.0)
+    mask = np.asarray(krum_accept_mask(jnp.asarray(X), 2))
+    assert mask.sum() == 2
+
+
+def test_pairwise_dists_nonnegative():
+    x = jnp.ones((5, 8), jnp.float32)  # identical rows -> exact zeros
+    d = np.asarray(pairwise_sq_dists(x))
+    assert np.all(d >= 0) and np.allclose(d, 0)
+
+
+def test_collusion_override():
+    # poisoners = ids above ceil(N(1-po)) (ref: krum.go:47-58)
+    assert not collusion_accept_override(10, 100, 0.0)
+    assert collusion_accept_override(95, 100, 0.30)
+    assert not collusion_accept_override(50, 100, 0.30)
+
+
+def test_roni_accepts_good_rejects_bad():
+    m = softmax_model(16, 4)
+    key = jax.random.PRNGKey(0)
+    means = jax.random.normal(key, (4, 16)) * 4.0
+    y = jnp.arange(200) % 4
+    x = means[y] + jax.random.normal(jax.random.PRNGKey(1), (200, 16))
+    w = m.flat_init(key)
+    # a good update: one gradient step; a bad update: the opposite direction
+    g = jax.grad(m.loss_flat)(w, x, y)
+    deltas = jnp.stack([-g, 20.0 * g])
+    kernel = make_roni_kernel(m)
+    mask = np.asarray(kernel(w, deltas, x, y))
+    scores = np.asarray(roni_scores(m, w, deltas, x, y))
+    assert mask[0] and not mask[1]
+    assert scores[1] > scores[0]
