@@ -1,0 +1,12 @@
+"""Host-side crypto plane.
+
+Everything vector-shaped (quantization, share polynomial math, recovery)
+lives in XLA under `biscotti_tpu.ops`; this package is the *control-plane*
+crypto that stays on the host CPU (SURVEY.md §2.2, §2.7):
+
+  * `ed25519`  — pure-Python Edwards25519 group (RFC 8032 arithmetic)
+  * `vrf`      — ECVRF prove/verify (RFC 9381 TAI shape) for role lotteries
+  * `commitments` — Pedersen vector commitments + Feldman-style verifiable
+                 Shamir shares + Schnorr signatures (C++ fast path via
+                 ctypes, pure-Python fallback)
+"""

@@ -112,10 +112,14 @@ class Blockchain:
         """Longest-chain adoption on (re)join (ref: main.go:1001-1013).
 
         Guards against Byzantine suppliers: the candidate must (a) verify
-        structurally, (b) extend this chain's existing prefix — a longer but
-        *divergent* history (e.g. a re-sealed forgery from a different
-        genesis or a rewritten past block) is refused — and (c) blocks are
-        deep-copied so the supplier cannot mutate our chain afterwards.
+        structurally, (b) extend this chain's existing *settled* prefix — a
+        longer but divergent history (e.g. a re-sealed forgery from a
+        different genesis or a rewritten past block) is refused. Our own tip
+        is exempt from the prefix check: it is still replaceable at its
+        height (ref: honest.go:649-653), so a peer holding the losing fork
+        block must still be able to adopt the canonical longer chain.
+        Finally (c) blocks are deep-copied so the supplier cannot mutate our
+        chain afterwards.
         """
         if len(other.blocks) <= len(self.blocks):
             return False
@@ -123,7 +127,7 @@ class Blockchain:
             other.verify()
         except ChainInvariantError:
             return False
-        for mine, theirs in zip(self.blocks, other.blocks):
+        for mine, theirs in zip(self.blocks[:-1], other.blocks):
             if mine.hash != theirs.hash:
                 return False
         self.blocks = copy.deepcopy(other.blocks)

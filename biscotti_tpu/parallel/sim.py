@@ -39,7 +39,7 @@ import numpy as np
 from biscotti_tpu.config import BiscottiConfig, Defense
 from biscotti_tpu.data import datasets as ds
 from biscotti_tpu.models.base import Model
-from biscotti_tpu.models.trainer import local_step_fn
+from biscotti_tpu.models.trainer import local_step_fn, sample_batch
 from biscotti_tpu.models.zoo import model_for_dataset
 from biscotti_tpu.ops import dp_noise
 from biscotti_tpu.ops.krum import default_num_adversaries, krum_accept_mask
@@ -58,6 +58,29 @@ class RoundLog:
 
     def csv(self) -> str:
         return f"{self.iteration},{self.error:.6f},{self.timestamp:.6f}"
+
+
+def defense_mask(defense: Defense, model: Model, w: jax.Array,
+                 noised: jax.Array, x_val: jax.Array, y_val: jax.Array,
+                 roni_threshold: float, num_adversaries: int) -> jax.Array:
+    """Verifier-committee accept mask over the round's noised updates —
+    shared by the single-chip (vmap) and sharded (shard_map) round steps so
+    the two paths cannot drift."""
+    n = noised.shape[0]
+    if defense == Defense.KRUM:
+        return krum_accept_mask(noised, num_adversaries)
+    if defense == Defense.RONI:
+        return roni_accept_mask(model, w, noised, x_val, y_val, roni_threshold)
+    return jnp.ones((n,), jnp.bool_)
+
+
+def masked_aggregate(mask: jax.Array, deltas: jax.Array, noised: jax.Array,
+                     dp_in_model: bool) -> jax.Array:
+    """Miner aggregation: sum of accepted RAW deltas (the noised copies exist
+    only for verification, ref: SURVEY §2.3 row 21) — except in dp_in_model
+    mode where the noise IS part of the update (ref: honest.go:172-179)."""
+    agg_src = noised if dp_in_model else deltas
+    return jnp.sum(jnp.where(mask[:, None], agg_src, 0.0), axis=0)
 
 
 def _poisoned_ids(num_nodes: int, poison_fraction: float) -> set:
@@ -140,8 +163,7 @@ class Simulator:
         defense = cfg.defense if cfg.verification else Defense.NONE
 
         def one_delta(w, key, xi, yi):
-            idx = jax.random.choice(key, self.rows, (min(batch, self.rows),),
-                                    replace=False)
+            idx = sample_batch(key, self.rows, batch)
             return self._step(w, xi[idx], yi[idx])
 
         def round_step(w, stake, it):
@@ -162,21 +184,10 @@ class Simulator:
                 noise = jnp.zeros_like(deltas)
             noised = deltas + noise
 
-            if defense == Defense.KRUM:
-                mask = krum_accept_mask(noised, default_num_adversaries(s))
-            elif defense == Defense.RONI:
-                mask = roni_accept_mask(model, w, noised, self.x_val, self.y_val,
-                                        cfg.roni_threshold)
-            else:
-                mask = jnp.ones((s,), jnp.bool_)
-
-            # miners aggregate the RAW deltas of accepted updates; the noised
-            # copies exist only for verification (ref: SURVEY §2.3 row 21).
-            # In dp_in_model mode the noise IS part of the update
-            # (ref: honest.go:172-179).
-            agg_src = noised if cfg.dp_in_model else deltas
-            agg = jnp.sum(jnp.where(mask[:, None], agg_src, 0.0), axis=0)
-            w_next = w + agg
+            mask = defense_mask(defense, model, w, noised, self.x_val,
+                                self.y_val, cfg.roni_threshold,
+                                default_num_adversaries(s))
+            w_next = w + masked_aggregate(mask, deltas, noised, cfg.dp_in_model)
 
             delta_stake = jnp.where(mask, cfg.stake_unit, -cfg.stake_unit)
             stake_next = stake.at[cidx].add(delta_stake)
@@ -198,7 +209,8 @@ class Simulator:
         """Python round loop over the jitted step; returns (w, stake, logs).
         Log rows mirror the reference's parsed node-0 output so eval tooling
         is directly comparable (BASELINE.md)."""
-        num_rounds = num_rounds or self.cfg.max_iterations
+        if num_rounds is None:
+            num_rounds = self.cfg.max_iterations
         w, stake = self.init_state()
         logs: List[RoundLog] = []
         for it in range(num_rounds):
@@ -214,7 +226,8 @@ class Simulator:
         """Whole training as ONE compiled XLA program (`lax.scan` over
         rounds) — no host in the loop at all. Upper bound of the TPU design;
         nothing in the reference's architecture can express this."""
-        num_rounds = num_rounds or self.cfg.max_iterations
+        if num_rounds is None:
+            num_rounds = self.cfg.max_iterations
         w, stake = self.init_state()
         step = self._round_step_raw
 
@@ -266,9 +279,7 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
 
     def local_deltas(w, x_loc, y_loc, it):
         def one(key, xi, yi):
-            idx = jax.random.choice(key, sim.rows,
-                                    (min(cfg.batch_size, sim.rows),),
-                                    replace=False)
+            idx = sample_batch(key, sim.rows, cfg.batch_size)
             return sim._step(w, xi[idx], yi[idx])
 
         pid = jax.lax.axis_index(axis)
@@ -288,18 +299,12 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
     def sharded_step(w, x_loc, y_loc, it):
         deltas, noised = local_deltas(w, x_loc, y_loc, it)
         all_noised = jax.lax.all_gather(noised, axis, tiled=True)  # [N, d]
-        if defense == Defense.KRUM:
-            mask = krum_accept_mask(all_noised, f)
-        elif defense == Defense.RONI:
-            mask = roni_accept_mask(model, w, all_noised, sim.x_val, sim.y_val,
-                                    cfg.roni_threshold)
-        else:
-            mask = jnp.ones((n,), jnp.bool_)
+        mask = defense_mask(defense, model, w, all_noised, sim.x_val,
+                            sim.y_val, cfg.roni_threshold, f)
         pid = jax.lax.axis_index(axis)
         n_loc = deltas.shape[0]
         local_mask = jax.lax.dynamic_slice_in_dim(mask, pid * n_loc, n_loc)
-        agg_src = noised if cfg.dp_in_model else deltas
-        local_agg = jnp.sum(jnp.where(local_mask[:, None], agg_src, 0.0), axis=0)
+        local_agg = masked_aggregate(local_mask, deltas, noised, cfg.dp_in_model)
         agg = jax.lax.psum(local_agg, axis)
         w_next = w + agg
         err = model.error_flat(w_next, sim.x_val, sim.y_val)

@@ -6,10 +6,19 @@ Must run before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: the session env may point JAX_PLATFORMS at real TPU hardware
+# (a sitecustomize hook imports jax at interpreter startup), but the test
+# suite always runs on the virtual 8-device CPU mesh. Since jax may already be
+# imported with the TPU platform captured, override via jax.config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", os.environ["JAX_ENABLE_X64"] == "1")

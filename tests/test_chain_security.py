@@ -44,13 +44,15 @@ def _mk_forged_full(chain):
 
 
 def test_resealed_divergent_chain_not_adopted():
-    # a properly-sealed chain from a divergent history (different deltas ->
-    # different hashes at overlapping heights) must be refused even though
-    # verify() passes on it
+    # a properly-sealed chain that rewrites *settled* history (divergence
+    # buried below our replaceable tip) must be refused even though verify()
+    # passes on it. (Divergence at the tip itself is allowed — the tip is
+    # replaceable, see test_adoption_with_losing_fork_tip.)
     honest = Blockchain(num_params=4, num_nodes=2)
     honest.add_block(_block(honest, ndeltas=1))
+    honest.add_block(_block(honest, ndeltas=1))  # height-0 is now settled
     evil = Blockchain(num_params=4, num_nodes=2)
-    for _ in range(3):
+    for _ in range(4):
         evil.add_block(_block(evil, ndeltas=2))  # diverges at height 0
     evil.verify()  # structurally fine
     assert honest.maybe_adopt(evil) is False

@@ -109,6 +109,25 @@ def test_longest_chain_adoption():
     assert not a.maybe_adopt(b)
 
 
+def test_adoption_with_losing_fork_tip():
+    # A peer whose tip lost a same-height replacement race must still be able
+    # to adopt the canonical longer chain (ref: honest.go:649-653 replacement
+    # + main.go:1001-1013 adoption). Only the tip may diverge — deeper
+    # rewrites stay refused (test_chain_security covers that).
+    a = Blockchain(num_params=8, num_nodes=4)
+    b = Blockchain(num_params=8, num_nodes=4)
+    shared = _mk_block(a)
+    a.add_block(shared)
+    b.add_block(shared)
+    # b seals its own (losing) block at height 1; a seals the canonical one
+    # and extends past it
+    b.add_block(_mk_block(b, tag=9.0))
+    a.add_block(_mk_block(a, tag=2.0))
+    a.add_block(_mk_block(a, tag=3.0))
+    assert b.maybe_adopt(a)
+    assert b.dump() == a.dump()
+
+
 def test_chain_equality_oracle_across_replicas():
     # Two peers applying the same block stream must print identical ledgers
     # (the localTest.sh oracle, ref: DistSys/localTest.sh:40-96).
