@@ -1,0 +1,89 @@
+"""ctypes bridge to the C++ crypto library (native/libbiscotti_native.so).
+
+Loaded lazily; `available()` is False (and the pure-Python paths run) until
+`make -C native` has produced the shared object. Negative scalars are
+handled here by negating the point — the C side sees small non-negative
+scalars, which keeps Pippenger window counts minimal for quantized updates.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+from biscotti_tpu.crypto import ed25519 as ed
+
+_LIB_PATHS = [
+    os.path.join(os.path.dirname(__file__), "..", "..", "native",
+                 "libbiscotti_native.so"),
+]
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    for path in _LIB_PATHS:
+        full = os.path.abspath(path)
+        if os.path.exists(full):
+            try:
+                lib = ctypes.CDLL(full)
+                lib.ed25519_msm.restype = ctypes.c_int
+                lib.ed25519_msm.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.c_char_p,
+                ]
+                _lib = lib
+                break
+            except OSError:
+                continue
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fe_bytes(v: int) -> bytes:
+    return (v % ed.P).to_bytes(32, "little")
+
+
+def _point_bytes(p: ed.Point) -> bytes:
+    x, y, z, t = p
+    return _fe_bytes(x) + _fe_bytes(y) + _fe_bytes(z) + _fe_bytes(t)
+
+
+def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(scalars) != len(points):
+        raise ValueError("scalar/point length mismatch")
+    sbuf = bytearray()
+    pbuf = bytearray()
+    n = 0
+    for s, p in zip(scalars, points):
+        s = s % ed.Q
+        if s == 0:
+            continue
+        # keep scalars short: a value in the top half of Z_q is a small
+        # negative — use |s| with the negated point instead
+        if s > ed.Q // 2:
+            s = ed.Q - s
+            p = ed.point_neg(p)
+        sbuf += s.to_bytes(32, "little")
+        pbuf += _point_bytes(p)
+        n += 1
+    if n == 0:
+        return ed.IDENTITY
+    out = ctypes.create_string_buffer(64)
+    rc = lib.ed25519_msm(bytes(sbuf), bytes(pbuf), n, out)
+    if rc != 0:
+        raise RuntimeError(f"native msm failed: {rc}")
+    x = int.from_bytes(out.raw[:32], "little")
+    y = int.from_bytes(out.raw[32:], "little")
+    return (x, y, 1, (x * y) % ed.P)

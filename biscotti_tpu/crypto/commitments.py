@@ -1,0 +1,252 @@
+"""Pedersen commitments, Schnorr signatures, and pairing-free verifiable
+secret sharing over Edwards25519.
+
+Reference capabilities being reproduced (SURVEY.md §2.2):
+  * polynomial/vector commitment to the quantized update:
+    C = Σ qᵢ·PKᵢ over bn256 G1 (ref: DistSys/kyber.go:533-562
+    createCommitment, verified by recompute kyber.go:564-577)
+  * Schnorr signatures over commitments (ref: kyber.go:873-925)
+  * per-share witnesses a miner can check against the sender's commitment
+    (ref: kyber.go:611-673 — KZG-style, verified with a bn256 *pairing*)
+
+Design departure, documented on purpose: the reference's share-witness check
+needs a pairing-friendly curve. This build replaces it with **Pedersen VSS**
+(coefficient commitments Cⱼ = aⱼ·G + bⱼ·H plus a parallel blinding-polynomial
+share; check: s·G + t·H == Σ xʲ·Cⱼ), which delivers the same capability —
+shares verifiable against a binding, hiding commitment to the polynomial —
+on a single fast curve with no pairings. Plain Feldman (aⱼ·G) would leak
+low-entropy quantized coefficients to a baby-step/giant-step search; the
+blinding term closes that.
+
+The group is the same Edwards25519 used by the VRF; scalars live in Z_q.
+Pure-Python backend here (control-plane correctness); `native/` provides a
+C++ fast path for the O(d) MSM hot spot, loaded lazily via ctypes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from biscotti_tpu.crypto import ed25519 as ed
+
+_Q = ed.Q
+
+
+def _hash_to_point(label: bytes) -> ed.Point:
+    """Nothing-up-my-sleeve generator derivation via the shared
+    try-and-increment hash-to-curve in ed25519.py."""
+    return ed.hash_to_point(b"biscotti-gen" + label)
+
+
+# Secondary generator for Pedersen blinding; independent of B by construction.
+H_POINT = _hash_to_point(b"pedersen-H")
+
+
+def _scalar(v: int) -> int:
+    return v % _Q
+
+
+def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
+    """Multi-scalar multiplication Σ sᵢ·Pᵢ (Pippenger bucket method).
+
+    This is the reference's per-update hot spot — an O(d) MSM per round per
+    peer (ref: kyber.go:533-562 at d=7,850 dominated its CPU budget,
+    SURVEY.md §7.3). The C++ backend in native/ replaces this when built.
+    """
+    try:
+        from biscotti_tpu.crypto import _native
+
+        if _native.available():
+            return _native.msm(scalars, points)
+    except ImportError:
+        pass
+    return _msm_python(scalars, points)
+
+
+def _msm_python(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
+    if len(scalars) != len(points):
+        raise ValueError("scalar/point length mismatch")
+    pairs = [(_scalar(s), p) for s, p in zip(scalars, points) if _scalar(s)]
+    if not pairs:
+        return ed.IDENTITY
+    c = 8 if len(pairs) >= 32 else 4  # window bits
+    maxbits = max(s.bit_length() for s, _ in pairs)
+    acc = ed.IDENTITY
+    for w in range((maxbits + c - 1) // c - 1, -1, -1):
+        if not ed.is_identity(acc):
+            for _ in range(c):
+                acc = ed.point_double(acc)
+        buckets: List[ed.Point] = [ed.IDENTITY] * (1 << c)
+        for s, p in pairs:
+            idx = (s >> (w * c)) & ((1 << c) - 1)
+            if idx:
+                buckets[idx] = ed.point_add(buckets[idx], p)
+        running = ed.IDENTITY
+        window_sum = ed.IDENTITY
+        for b in range((1 << c) - 1, 0, -1):
+            running = ed.point_add(running, buckets[b])
+            window_sum = ed.point_add(window_sum, running)
+        acc = ed.point_add(acc, window_sum)
+    return acc
+
+
+# ------------------------------------------------------------- commit key
+
+
+@dataclass
+class CommitKey:
+    """d independent generators, one per model parameter — the trusted
+    dealer's `commitKey.json` equivalent (ref:
+    keyGeneration/generateBootstrapFile.go:26-120, honest.go:760-871).
+
+    Derived transparently from a seed label instead of a dealer's secret
+    MSM ladder (ref: publicKey.go:26-61): no trapdoor exists at all, which
+    strictly improves on the reference's trusted-dealer assumption."""
+
+    points: List[ed.Point]
+
+    @classmethod
+    def generate(cls, dims: int, label: bytes = b"commit-key") -> "CommitKey":
+        return cls([_hash_to_point(label + i.to_bytes(4, "little"))
+                    for i in range(dims)])
+
+    def serialize(self) -> List[str]:
+        return [ed.point_compress(p).hex() for p in self.points]
+
+    @classmethod
+    def deserialize(cls, items: Sequence[str]) -> "CommitKey":
+        pts = []
+        for s in items:
+            p = ed.point_decompress(bytes.fromhex(s))
+            if p is None:
+                raise ValueError("invalid commit-key point")
+            pts.append(p)
+        return cls(pts)
+
+
+def commit_update(q: np.ndarray, key: CommitKey) -> bytes:
+    """C = Σ qᵢ·Gᵢ (ref: kyber.go:533-562). `q` is the int64 quantized
+    update; negative entries map to Z_q."""
+    if len(q) > len(key.points):
+        raise ValueError(f"update dim {len(q)} exceeds commit key {len(key.points)}")
+    return ed.point_compress(msm([int(v) for v in q], key.points[: len(q)]))
+
+
+def verify_commitment(commitment: bytes, q: np.ndarray, key: CommitKey) -> bool:
+    """Recompute-and-compare (ref: kyber.go:564-577)."""
+    try:
+        return commit_update(q, key) == commitment
+    except ValueError:
+        return False
+
+
+# ------------------------------------------------------------- Schnorr
+
+
+def schnorr_sign(seed: bytes, message: bytes) -> bytes:
+    """Deterministic Schnorr over Ed25519 (ref: kyber.go:873-896 signs with
+    bn256; the curve is an implementation detail of the capability)."""
+    x, prefix = ed.secret_expand(seed)
+    pk = ed.point_compress(ed.base_mult(x))
+    k = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % _Q
+    r_pt = ed.base_mult(k)
+    r = ed.point_compress(r_pt)
+    c = int.from_bytes(
+        hashlib.sha512(r + pk + message).digest(), "little"
+    ) % _Q
+    s = (k + c * x) % _Q
+    return r + s.to_bytes(32, "little")
+
+
+def schnorr_verify(public: bytes, message: bytes, signature: bytes) -> bool:
+    """(ref: kyber.go:898-925)."""
+    if len(signature) != 64:
+        return False
+    r_pt = ed.point_decompress(signature[:32])
+    y_pt = ed.point_decompress(public)
+    if r_pt is None or y_pt is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= _Q:
+        return False
+    c = int.from_bytes(
+        hashlib.sha512(signature[:32] + public + message).digest(), "little"
+    ) % _Q
+    # s·B == R + c·Y
+    lhs = ed.base_mult(s)
+    rhs = ed.point_add(r_pt, ed.scalar_mult(c, y_pt))
+    return ed.point_equal(lhs, rhs)
+
+
+# ------------------------------------------------------- Pedersen VSS
+
+
+@dataclass
+class ChunkVSS:
+    """Verifiable sharing of ONE polynomial chunk: coefficient commitments
+    plus the blinding polynomial the prover evaluates alongside the real one.
+    Plays the role of the reference's per-chunk commitment + KZG witnesses
+    (ref: kyber.go:579-673) without pairings."""
+
+    commitments: List[bytes]  # Cⱼ = aⱼ·G + bⱼ·H, j = 0..k−1
+
+    def verify_share(self, x: int, share: int, blind_share: int) -> bool:
+        """Check share·G + blind·H == Σ xʲ·Cⱼ — accepts iff (share, blind)
+        is a true evaluation of the committed polynomial pair at x."""
+        lhs = ed.point_add(
+            ed.base_mult(_scalar(share)),
+            ed.scalar_mult(_scalar(blind_share), H_POINT),
+        )
+        rhs = ed.IDENTITY
+        xj = 1
+        for c_bytes in self.commitments:
+            c_pt = ed.point_decompress(c_bytes)
+            if c_pt is None:
+                return False
+            rhs = ed.point_add(rhs, ed.scalar_mult(_scalar(xj), c_pt))
+            xj = (xj * x) % _Q
+        return ed.point_equal(lhs, rhs)
+
+
+def vss_commit_chunk(coeffs: Sequence[int], seed: bytes, chunk_index: int,
+                     context: bytes = b"") -> Tuple[ChunkVSS, List[int]]:
+    """Commit one chunk's coefficients; returns (commitments, blinding
+    coefficients). Blinding coefficients are derived deterministically from
+    the peer's secret seed AND `context` (pass the round's block hash or
+    iteration stamp): reusing blinds across rounds would let an observer
+    difference two rounds' commitments, cancel the H term, and brute-force
+    the low-entropy quantized coefficient deltas."""
+    blinds = [
+        int.from_bytes(
+            hashlib.sha512(
+                seed + b"vss-blind" + context
+                + chunk_index.to_bytes(4, "little")
+                + j.to_bytes(4, "little")
+            ).digest(),
+            "little",
+        ) % _Q
+        for j in range(len(coeffs))
+    ]
+    comms = [
+        ed.point_compress(
+            ed.point_add(
+                ed.base_mult(_scalar(int(a))),
+                ed.scalar_mult(b, H_POINT),
+            )
+        )
+        for a, b in zip(coeffs, blinds)
+    ]
+    return ChunkVSS(comms), blinds
+
+
+def eval_poly(coeffs: Sequence[int], x: int) -> int:
+    """Exact integer Horner evaluation (shares themselves stay plain ints so
+    the XLA aggregation/recovery path is unchanged)."""
+    acc = 0
+    for a in reversed(list(coeffs)):
+        acc = acc * x + int(a)
+    return acc

@@ -127,7 +127,11 @@ class Blockchain:
             other.verify()
         except ChainInvariantError:
             return False
-        for mine, theirs in zip(self.blocks[:-1], other.blocks):
+        # the tip is exempt only when there IS a non-genesis tip: genesis is
+        # deterministic and never replaceable, so a genesis-only peer must
+        # still refuse a chain grown from a forged genesis
+        settled = self.blocks[:-1] if len(self.blocks) > 1 else self.blocks
+        for mine, theirs in zip(settled, other.blocks):
             if mine.hash != theirs.hash:
                 return False
         self.blocks = copy.deepcopy(other.blocks)

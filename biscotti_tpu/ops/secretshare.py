@@ -1,0 +1,132 @@
+"""Shamir-style share math for secure aggregation — batched XLA tensor ops.
+
+The reference generates each peer's shares with per-chunk scalar loops on the
+CPU (ref: DistSys/kyber.go:456-482 generateMinerSecretShares,
+kyber.go:579-646 createShareAndWitness, kyber.go:712-743 makePolynomialMap)
+and recovers the aggregate with a gonum QR least-squares solve
+(ref: kyber.go:809-867 recoverSecret/Vandermonde). Here the whole pipeline is
+three jitted tensor programs:
+
+    shares   = V @ coeffsᵀ        one [S,k]·[k,C] matmul for ALL chunks
+    agg      = Σ_peers shares     one sum (psum across miner shards)
+    coeffs'  = lstsq(V, agg)      one batched least-squares
+
+Semantics kept from the reference:
+  * quantization: int(x · 10^PRECISION), truncated toward zero
+    (ref: kyber.go:698-710; PRECISION=4, main.go:45)
+  * polynomial chunking: POLY_SIZE=10 coefficients per chunk, last chunk
+    zero-padded (ref: kyber.go:712-743; main.go:46)
+  * share points: x_i = i − SHARE_OFFSET for share index i
+    (ref: kyber.go:589 `minerSecretX := int64(minerPubKey - 10)`)
+  * integer polynomial evaluation — *exact* here via int64 Horner/matmul,
+    where the reference evaluates each term in float64 and truncates
+    (kyber.go:599-602), accumulating avoidable rounding error
+  * recovery: float64 Vandermonde least-squares, rounded back to int
+    (ref: kyber.go:809-867)
+  * per-miner striding: miner m holds share rows [m·S/M, (m+1)·S/M)
+    (ref: kyber.go:205-242 extractMinerSecret)
+
+Requires x64 mode (`jax.config.update("jax_enable_x64", True)`) — share
+values reach ~10¹³ for degree-9 chunks at PRECISION=4.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+PRECISION = 4  # ref: main.go:45
+POLY_SIZE = 10  # ref: main.go:46
+SHARE_OFFSET = 10  # ref: kyber.go:589
+
+
+def total_shares_for(num_miners: int, poly_size: int = POLY_SIZE) -> int:
+    """TOTAL_SHARES = ceil(2·POLY_SIZE/NUM_MINERS)·NUM_MINERS
+    (ref: main.go:825)."""
+    return math.ceil(2 * poly_size / num_miners) * num_miners
+
+
+def quantize(delta: jax.Array, precision: int = PRECISION) -> jax.Array:
+    """float → int64 at 10^precision, truncated toward zero like Go's
+    int64() conversion (ref: kyber.go:698-710)."""
+    scaled = delta.astype(jnp.float64) * (10.0 ** precision)
+    return jnp.trunc(scaled).astype(jnp.int64)
+
+
+def dequantize(q: jax.Array, precision: int = PRECISION) -> jax.Array:
+    return q.astype(jnp.float64) / (10.0 ** precision)
+
+
+def num_chunks(num_params: int, poly_size: int = POLY_SIZE) -> int:
+    return -(-num_params // poly_size)
+
+
+def to_chunks(q: jax.Array, poly_size: int = POLY_SIZE) -> jax.Array:
+    """[d] int64 → [C, k] coefficient rows, zero-padded last chunk
+    (ref: kyber.go:712-743)."""
+    d = q.shape[0]
+    c = num_chunks(d, poly_size)
+    padded = jnp.zeros((c * poly_size,), q.dtype).at[:d].set(q)
+    return padded.reshape(c, poly_size)
+
+
+def from_chunks(coeffs: jax.Array, num_params: int) -> jax.Array:
+    return coeffs.reshape(-1)[:num_params]
+
+
+def share_xs(total_shares: int, offset: int = SHARE_OFFSET) -> jax.Array:
+    """x_i = i − offset; note x = 0 occurs at i = offset, exactly as in the
+    reference (kyber.go:589)."""
+    return jnp.arange(total_shares, dtype=jnp.int64) - offset
+
+
+def vandermonde(xs: jax.Array, poly_size: int = POLY_SIZE) -> jax.Array:
+    """V[s, j] = xs[s]^j, int64 exact (|x| ≤ S, j < k → well inside int64)."""
+    powers = jnp.arange(poly_size, dtype=jnp.int64)
+    return xs[:, None] ** powers[None, :]
+
+
+@partial(jax.jit, static_argnames=("poly_size", "total_shares"))
+def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
+                total_shares: int = 2 * POLY_SIZE) -> jax.Array:
+    """[d] quantized update → [S, C] share matrix: share s of chunk c is the
+    exact integer evaluation of chunk-polynomial c at x_s."""
+    coeffs = to_chunks(q, poly_size)  # [C, k]
+    v = vandermonde(share_xs(total_shares), poly_size)  # [S, k]
+    return v @ coeffs.T  # [S, C]
+
+
+def miner_rows(total_shares: int, miner_idx: int, num_miners: int) -> slice:
+    """Miner m's contiguous share-row range (ref: kyber.go:205-242)."""
+    per = total_shares // num_miners
+    return slice(miner_idx * per, (miner_idx + 1) * per)
+
+
+@jax.jit
+def aggregate_shares(peer_shares: jax.Array) -> jax.Array:
+    """Homomorphic aggregation: [P, S, C] → [S, C]. Works identically on a
+    miner's slice [P, S/M, C] (ref: kyber.go:244-287 aggregateSecret)."""
+    return jnp.sum(peer_shares, axis=0)
+
+
+@partial(jax.jit, static_argnames=("poly_size",))
+def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
+                   poly_size: int = POLY_SIZE) -> jax.Array:
+    """[S, C] aggregated shares (+ their x points) → [C, k] int64 chunk
+    coefficients via float64 least-squares, rounded (ref: kyber.go:809-867 —
+    the reference also recovers approximately, via mat64 QR)."""
+    v = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
+    sol, _, _, _ = jnp.linalg.lstsq(v, agg_shares.astype(jnp.float64))
+    return jnp.round(sol.T).astype(jnp.int64)  # [C, k]
+
+
+def recover_update(agg_shares: jax.Array, xs: jax.Array, num_params: int,
+                   poly_size: int = POLY_SIZE,
+                   precision: int = PRECISION) -> jax.Array:
+    """Full miner-side recovery: aggregated shares → float aggregate update
+    (ref: honest.go:442-502 recoverAggregateUpdates)."""
+    coeffs = recover_coeffs(agg_shares, xs, poly_size)
+    return dequantize(from_chunks(coeffs, num_params), precision)

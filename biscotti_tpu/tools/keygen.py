@@ -1,0 +1,95 @@
+"""Bootstrap key dealer — the reference's offline keyGeneration step.
+
+The reference's trusted dealer builds a commitment key of size = model dims
+from a secret MSM ladder and per-node bn256 keypairs, writing
+`commitKey.json`, `pKeyG1.json` and `peersfile.txt` for every node to read
+at startup (ref: keyGeneration/generateBootstrapFile.go:26-120,
+publicKey.go:26-61; consumed by DistSys/honest.go:760-871).
+
+This dealer is *transparent*: the commitment key is hash-derived from a
+public label (no dealer secret exists, strictly weaker trust assumption) and
+node identities are 32-byte seeds from OS randomness. Artifacts:
+
+    commit_key.json   {"dims": d, "label": ..., "points": [hex, ...]}
+    node_keys.json    {"<id>": {"schnorr_seed": hex, "vrf_roles_seed": hex,
+                                "vrf_noise_seed": hex, "schnorr_pub": hex,
+                                "vrf_roles_pub": hex, "vrf_noise_pub": hex}}
+    peers.txt         host:port per line (ref: peersfile.txt shape)
+
+Usage:  python -m biscotti_tpu.tools.keygen --dims 7850 --nodes 100 \
+            --out ./keys [--host 127.0.0.1 --base-port 8000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+
+from biscotti_tpu.crypto import ed25519 as ed
+from biscotti_tpu.crypto.commitments import CommitKey
+from biscotti_tpu.crypto.vrf import VRFKey
+
+
+def generate(dims: int, nodes: int, out_dir: str, host: str = "127.0.0.1",
+             base_port: int = 8000, label: str = "biscotti-tpu-v1") -> None:
+    os.makedirs(out_dir, exist_ok=True)
+
+    key = CommitKey.generate(dims, label.encode())
+    with open(os.path.join(out_dir, "commit_key.json"), "w") as f:
+        json.dump({"dims": dims, "label": label, "points": key.serialize()}, f)
+
+    node_keys = {}
+    for i in range(nodes):
+        schnorr_seed = secrets.token_bytes(32)
+        roles_seed = secrets.token_bytes(32)
+        noise_seed = secrets.token_bytes(32)
+        node_keys[str(i)] = {
+            "schnorr_seed": schnorr_seed.hex(),
+            "vrf_roles_seed": roles_seed.hex(),
+            "vrf_noise_seed": noise_seed.hex(),
+            "schnorr_pub": ed.public_key(schnorr_seed).hex(),
+            "vrf_roles_pub": VRFKey(roles_seed).public.hex(),
+            "vrf_noise_pub": VRFKey(noise_seed).public.hex(),
+        }
+    with open(os.path.join(out_dir, "node_keys.json"), "w") as f:
+        json.dump(node_keys, f, indent=1)
+
+    with open(os.path.join(out_dir, "peers.txt"), "w") as f:
+        for i in range(nodes):
+            f.write(f"{host}:{base_port + i}\n")
+
+
+def load_commit_key(out_dir: str) -> CommitKey:
+    with open(os.path.join(out_dir, "commit_key.json")) as f:
+        data = json.load(f)
+    return CommitKey.deserialize(data["points"])
+
+
+def load_node_keys(out_dir: str) -> dict:
+    with open(os.path.join(out_dir, "node_keys.json")) as f:
+        return json.load(f)
+
+
+def load_peers(out_dir: str) -> list:
+    with open(os.path.join(out_dir, "peers.txt")) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--dims", type=int, required=True,
+                    help="model parameter count (commit key size)")
+    ap.add_argument("--nodes", type=int, required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--base-port", type=int, default=8000)
+    args = ap.parse_args(argv)
+    generate(args.dims, args.nodes, args.out, args.host, args.base_port)
+    print(f"wrote commit_key.json, node_keys.json, peers.txt to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,326 @@
+// Native multi-scalar multiplication over Edwards25519 — the commitment
+// hot spot of the framework.
+//
+// Role parity: the reference's createCommitment is an O(d) elliptic-curve
+// MSM per update per round (ref: DistSys/kyber.go:533-562) executed by the
+// vendored pure-Go bn256 (ref: lib/dedis/kyber); at d=7,850 it dominated the
+// reference's CPU budget (SURVEY.md §7.3). This library is the C++ host-side
+// equivalent for our Edwards25519 commitment scheme: field arithmetic with
+// 5×51-bit limbs, extended-coordinate group law, Pippenger bucket MSM.
+//
+// C ABI (consumed by biscotti_tpu/crypto/_native.py via ctypes):
+//   ed25519_msm(scalars[n*32 LE], points[n*128: X,Y,Z,T 32B LE each],
+//               n, out[64: affine x,y 32B LE each]) -> 0 on success
+//
+// Variable-time throughout: every input is public (commitments are published
+// on the ledger; no secret scalars pass through this code path).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------- fe25519
+// Field element mod p = 2^255 - 19, 5 limbs of 51 bits.
+
+struct fe {
+  uint64_t v[5];
+};
+
+constexpr uint64_t MASK51 = (uint64_t(1) << 51) - 1;
+
+inline fe fe_zero() { return fe{{0, 0, 0, 0, 0}}; }
+inline fe fe_one() { return fe{{1, 0, 0, 0, 0}}; }
+
+inline void fe_carry(fe &r) {
+  uint64_t c;
+  for (int i = 0; i < 4; i++) {
+    c = r.v[i] >> 51;
+    r.v[i] &= MASK51;
+    r.v[i + 1] += c;
+  }
+  c = r.v[4] >> 51;
+  r.v[4] &= MASK51;
+  r.v[0] += 19 * c;
+  // one more ripple in case limb0 overflowed 51 bits
+  c = r.v[0] >> 51;
+  r.v[0] &= MASK51;
+  r.v[1] += c;
+}
+
+inline fe fe_add(const fe &a, const fe &b) {
+  fe r;
+  for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
+  fe_carry(r);
+  return r;
+}
+
+// a - b, biasing by 2p so limbs stay non-negative
+inline fe fe_sub(const fe &a, const fe &b) {
+  fe r;
+  r.v[0] = a.v[0] + 0xFFFFFFFFFFFDAULL - b.v[0];
+  r.v[1] = a.v[1] + 0xFFFFFFFFFFFFEULL - b.v[1];
+  r.v[2] = a.v[2] + 0xFFFFFFFFFFFFEULL - b.v[2];
+  r.v[3] = a.v[3] + 0xFFFFFFFFFFFFEULL - b.v[3];
+  r.v[4] = a.v[4] + 0xFFFFFFFFFFFFEULL - b.v[4];
+  fe_carry(r);
+  return r;
+}
+
+inline fe fe_mul(const fe &a, const fe &b) {
+  u128 t0 = 0, t1 = 0, t2 = 0, t3 = 0, t4 = 0;
+  uint64_t a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3], a4 = a.v[4];
+  uint64_t b0 = b.v[0], b1 = b.v[1], b2 = b.v[2], b3 = b.v[3], b4 = b.v[4];
+  uint64_t b1_19 = 19 * b1, b2_19 = 19 * b2, b3_19 = 19 * b3, b4_19 = 19 * b4;
+
+  t0 = (u128)a0 * b0 + (u128)a1 * b4_19 + (u128)a2 * b3_19 + (u128)a3 * b2_19 + (u128)a4 * b1_19;
+  t1 = (u128)a0 * b1 + (u128)a1 * b0 + (u128)a2 * b4_19 + (u128)a3 * b3_19 + (u128)a4 * b2_19;
+  t2 = (u128)a0 * b2 + (u128)a1 * b1 + (u128)a2 * b0 + (u128)a3 * b4_19 + (u128)a4 * b3_19;
+  t3 = (u128)a0 * b3 + (u128)a1 * b2 + (u128)a2 * b1 + (u128)a3 * b0 + (u128)a4 * b4_19;
+  t4 = (u128)a0 * b4 + (u128)a1 * b3 + (u128)a2 * b2 + (u128)a3 * b1 + (u128)a4 * b0;
+
+  fe r;
+  uint64_t c;
+  r.v[0] = (uint64_t)t0 & MASK51; c = (uint64_t)(t0 >> 51);
+  t1 += c;
+  r.v[1] = (uint64_t)t1 & MASK51; c = (uint64_t)(t1 >> 51);
+  t2 += c;
+  r.v[2] = (uint64_t)t2 & MASK51; c = (uint64_t)(t2 >> 51);
+  t3 += c;
+  r.v[3] = (uint64_t)t3 & MASK51; c = (uint64_t)(t3 >> 51);
+  t4 += c;
+  r.v[4] = (uint64_t)t4 & MASK51; c = (uint64_t)(t4 >> 51);
+  r.v[0] += 19 * c;
+  c = r.v[0] >> 51; r.v[0] &= MASK51; r.v[1] += c;
+  return r;
+}
+
+inline fe fe_sq(const fe &a) { return fe_mul(a, a); }
+
+// a^(p-2) mod p — Fermat inversion, simple square-and-multiply over the
+// fixed exponent p-2 = 2^255 - 21 (vartime; fine for public data).
+fe fe_invert(const fe &a) {
+  // p - 2 bits: 255 bits, all ones except positions 0..4 pattern of 2^255-21
+  // 2^255 - 21 = 0b0111...11101011  (low bits: ...11101011)
+  fe r = fe_one();
+  fe base = a;
+  // exponent little-endian bits
+  // low 5 bits of (2^255 - 21): 2^255-21 mod 32 = 32-21=11 -> 01011
+  // Build exponent as bytes: p-2 = 2^255 - 21
+  uint8_t e[32];
+  memset(e, 0xFF, 32);
+  e[31] = 0x7F;
+  e[0] = 0xEB;  // 0xED - 2
+  for (int i = 255; i >= 0; i--) {
+    r = fe_sq(r);
+    if ((e[i >> 3] >> (i & 7)) & 1) r = fe_mul(r, base);
+  }
+  return r;
+}
+
+// canonical reduction and serialization
+void fe_tobytes(uint8_t out[32], const fe &a) {
+  fe t = a;
+  fe_carry(t);
+  fe_carry(t);  // second pass fully normalizes every limb below 2^51
+  uint64_t l[5] = {t.v[0], t.v[1], t.v[2], t.v[3], t.v[4]};
+  // freeze: value < 2p here, so at most one conditional subtract of
+  // p = {2^51-19, 2^51-1, 2^51-1, 2^51-1, 2^51-1}
+  bool ge = (l[4] == MASK51 && l[3] == MASK51 && l[2] == MASK51 &&
+             l[1] == MASK51 && l[0] >= MASK51 - 18);
+  if (ge) {
+    l[0] -= (MASK51 - 18);
+    l[1] = 0; l[2] = 0; l[3] = 0; l[4] = 0;
+  }
+  // pack 5×51 -> 32 bytes LE
+  uint8_t o[32];
+  memset(o, 0, 32);
+  u128 acc = 0;
+  int bits = 0, idx = 0;
+  for (int i = 0; i < 5; i++) {
+    acc |= (u128)l[i] << bits;
+    bits += 51;
+    while (bits >= 8 && idx < 32) {
+      o[idx++] = (uint8_t)acc;
+      acc >>= 8;
+      bits -= 8;
+    }
+  }
+  while (idx < 32) { o[idx++] = (uint8_t)acc; acc >>= 8; }
+  memcpy(out, o, 32);
+}
+
+fe fe_frombytes(const uint8_t in[32]) {
+  fe r;
+  u128 acc = 0;
+  int bits = 0, idx = 0;
+  for (int i = 0; i < 5; i++) {
+    while (bits < 51 && idx < 32) {
+      acc |= (u128)in[idx++] << bits;
+      bits += 8;
+    }
+    r.v[i] = (uint64_t)acc & MASK51;
+    acc >>= 51;
+    bits -= 51;
+  }
+  r.v[4] &= MASK51 >> 0;  // top bits beyond 255 dropped
+  return r;
+}
+
+// ---------------------------------------------------------------- group ops
+// Extended homogeneous coordinates, a = -1 twisted Edwards.
+
+// 2*d mod p, d = -121665/121666
+const fe D2 = fe{{0x69B9426B2F159ULL, 0x35050762ADD7AULL, 0x3CF44C0038052ULL,
+                  0x6738CC7407977ULL, 0x2406D9DC56DFFULL}};
+
+struct ge {
+  fe X, Y, Z, T;
+};
+
+inline ge ge_identity() { return ge{fe_zero(), fe_one(), fe_one(), fe_zero()}; }
+
+inline bool ge_is_identity(const ge &p) {
+  // X == 0 and Y == Z
+  uint8_t x[32], y[32], z[32];
+  fe_tobytes(x, p.X);
+  fe_tobytes(y, p.Y);
+  fe_tobytes(z, p.Z);
+  static const uint8_t zero[32] = {0};
+  return memcmp(x, zero, 32) == 0 && memcmp(y, z, 32) == 0;
+}
+
+inline ge ge_add(const ge &p, const ge &q) {
+  fe a = fe_mul(fe_sub(p.Y, p.X), fe_sub(q.Y, q.X));
+  fe b = fe_mul(fe_add(p.Y, p.X), fe_add(q.Y, q.X));
+  fe c = fe_mul(fe_mul(p.T, D2), q.T);
+  fe d = fe_mul(fe_add(p.Z, p.Z), q.Z);
+  fe e = fe_sub(b, a);
+  fe f = fe_sub(d, c);
+  fe g = fe_add(d, c);
+  fe h = fe_add(b, a);
+  return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+inline ge ge_double(const ge &p) {
+  fe a = fe_sq(p.X);
+  fe b = fe_sq(p.Y);
+  fe zz = fe_sq(p.Z);
+  fe c = fe_add(zz, zz);
+  fe h = fe_add(a, b);
+  fe xy = fe_add(p.X, p.Y);
+  fe e = fe_sub(h, fe_sq(xy));
+  fe g = fe_sub(a, b);
+  fe f = fe_add(c, g);
+  return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------- C ABI
+
+extern "C" {
+
+// Pippenger bucket MSM. scalars: n×32 bytes LE (already reduced mod group
+// order by the caller); points: n×128 bytes (X,Y,Z,T as 32-byte LE field
+// elements); out: 64 bytes affine (x, y).
+int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
+                uint8_t *out) {
+  if (n == 0) {
+    // identity: x=0, y=1
+    memset(out, 0, 64);
+    out[32] = 1;
+    return 0;
+  }
+  std::vector<ge> pts(n);
+  for (size_t i = 0; i < n; i++) {
+    const uint8_t *p = points + i * 128;
+    pts[i].X = fe_frombytes(p);
+    pts[i].Y = fe_frombytes(p + 32);
+    pts[i].Z = fe_frombytes(p + 64);
+    pts[i].T = fe_frombytes(p + 96);
+  }
+  // find highest set bit across scalars
+  int maxbit = -1;
+  for (size_t i = 0; i < n; i++) {
+    for (int byte = 31; byte >= 0; byte--) {
+      uint8_t v = scalars[i * 32 + byte];
+      if (v) {
+        int hb = 7;
+        while (!((v >> hb) & 1)) hb--;
+        int bit = byte * 8 + hb;
+        if (bit > maxbit) maxbit = bit;
+        break;
+      }
+    }
+  }
+  if (maxbit < 0) {
+    memset(out, 0, 64);
+    out[32] = 1;
+    return 0;
+  }
+
+  const int C = n >= 32 ? 8 : 4;  // window bits
+  const int nwin = (maxbit + C) / C;
+  std::vector<ge> buckets((size_t(1) << C));
+  ge acc = ge_identity();
+  bool acc_set = false;
+
+  for (int w = nwin - 1; w >= 0; w--) {
+    if (acc_set)
+      for (int k = 0; k < C; k++) acc = ge_double(acc);
+    for (auto &b : buckets) b = ge_identity();
+    std::vector<bool> used(buckets.size(), false);
+    for (size_t i = 0; i < n; i++) {
+      int bitpos = w * C;
+      uint32_t idx = 0;
+      for (int b = 0; b < C; b++) {
+        int bit = bitpos + b;
+        if (bit <= maxbit &&
+            ((scalars[i * 32 + (bit >> 3)] >> (bit & 7)) & 1))
+          idx |= (1u << b);
+      }
+      if (idx) {
+        buckets[idx] = used[idx] ? ge_add(buckets[idx], pts[i]) : pts[i];
+        used[idx] = true;
+      }
+    }
+    ge running = ge_identity();
+    bool running_set = false;
+    ge window_sum = ge_identity();
+    bool window_set = false;
+    for (int b = (1 << C) - 1; b >= 1; b--) {
+      if (used[b]) {
+        running = running_set ? ge_add(running, buckets[b]) : buckets[b];
+        running_set = true;
+      }
+      if (running_set) {
+        window_sum = window_set ? ge_add(window_sum, running) : running;
+        window_set = true;
+      }
+    }
+    if (window_set) {
+      acc = acc_set ? ge_add(acc, window_sum) : window_sum;
+      acc_set = true;
+    }
+  }
+  if (!acc_set) acc = ge_identity();
+
+  // affine: x = X/Z, y = Y/Z
+  fe zinv = fe_invert(acc.Z);
+  fe x = fe_mul(acc.X, zinv);
+  fe y = fe_mul(acc.Y, zinv);
+  fe_tobytes(out, x);
+  fe_tobytes(out + 32, y);
+  return 0;
+}
+
+// Single scalar mult via the same machinery (used by tests / keygen).
+int ed25519_scalarmult(const uint8_t *scalar, const uint8_t *point,
+                       uint8_t *out) {
+  return ed25519_msm(scalar, point, 1, out);
+}
+}

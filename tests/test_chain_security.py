@@ -79,6 +79,19 @@ def test_malformed_shard_names_raise():
         load_shard("mnist", "bogus7")
 
 
+def test_forged_genesis_not_adopted_by_fresh_peer():
+    # a genesis-only peer must refuse a chain grown from a different genesis
+    # (genesis is deterministic and never replaceable; the tip exemption in
+    # maybe_adopt must not apply to it)
+    fresh = Blockchain(num_params=4, num_nodes=2)
+    evil = Blockchain(num_params=4, num_nodes=2, default_stake=10**6)
+    for _ in range(2):
+        evil.add_block(_block(evil))
+    evil.verify()
+    assert fresh.maybe_adopt(evil) is False
+    assert len(fresh) == 1
+
+
 def test_forged_longer_chain_not_adopted():
     honest = Blockchain(num_params=4, num_nodes=2)
     evil = Blockchain(num_params=4, num_nodes=2)

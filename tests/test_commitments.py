@@ -1,0 +1,133 @@
+"""Stage-6b tests: Pedersen vector commitments, Schnorr, Pedersen-VSS share
+verification, and the end-to-end quantize→commit→share→verify→aggregate→
+recover pipeline (the kyber-demo round-trip, ref: kyber-demo/kyber.go:84-643)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from biscotti_tpu.crypto import commitments as cm
+from biscotti_tpu.crypto import ed25519 as ed
+from biscotti_tpu.ops import secretshare as ss
+
+KEY = cm.CommitKey.generate(32)  # module-level: generation is the slow part
+
+
+def test_msm_matches_naive():
+    pts = KEY.points[:5]
+    scalars = [3, 0, 7, 123456789, ed.Q - 2]
+    expect = ed.IDENTITY
+    for s, p in zip(scalars, pts):
+        expect = ed.point_add(expect, ed.scalar_mult(s % ed.Q, p))
+    assert ed.point_equal(cm._msm_python(scalars, pts), expect)
+
+
+def test_commitment_binds_and_verifies():
+    q = np.array([120000, -34567, 0, 999, -1], dtype=np.int64)
+    c = cm.commit_update(q, KEY)
+    assert cm.verify_commitment(c, q, KEY)
+    q2 = q.copy()
+    q2[3] += 1
+    assert not cm.verify_commitment(c, q2, KEY)
+    assert not cm.verify_commitment(c, np.zeros(64, np.int64), KEY)  # too big
+
+
+def test_commitment_is_homomorphic():
+    # C(a) + C(b) == C(a+b): the property miners rely on when aggregating
+    # committed updates
+    a = np.array([5, -3, 11], dtype=np.int64)
+    b = np.array([2, 9, -4], dtype=np.int64)
+    ca = ed.point_decompress(cm.commit_update(a, KEY))
+    cb = ed.point_decompress(cm.commit_update(b, KEY))
+    csum = cm.commit_update(a + b, KEY)
+    assert ed.point_compress(ed.point_add(ca, cb)) == csum
+
+
+def test_commit_key_serialization_roundtrip():
+    enc = KEY.serialize()
+    back = cm.CommitKey.deserialize(enc)
+    assert all(ed.point_equal(p, q) for p, q in zip(KEY.points, back.points))
+
+
+def test_schnorr_sign_verify():
+    seed = b"\x07" * 32
+    pk = ed.public_key(seed)
+    msg = b"commitment-bytes"
+    sig = cm.schnorr_sign(seed, msg)
+    assert cm.schnorr_verify(pk, msg, sig)
+    assert not cm.schnorr_verify(pk, b"other", sig)
+    assert not cm.schnorr_verify(ed.public_key(b"\x08" * 32), msg, sig)
+    bad = bytearray(sig)
+    bad[10] ^= 1
+    assert not cm.schnorr_verify(pk, msg, bytes(bad))
+
+
+def test_vss_share_verification():
+    seed = b"\x21" * 32
+    coeffs = [120000, -34567, 0, 999]  # one quantized chunk
+    vss, blinds = cm.vss_commit_chunk(coeffs, seed, chunk_index=0)
+    for x in (-10, -3, 1, 7):
+        share = cm.eval_poly(coeffs, x)
+        blind = cm.eval_poly(blinds, x)
+        assert vss.verify_share(x, share, blind)
+        assert not vss.verify_share(x, share + 1, blind)
+        assert not vss.verify_share(x, share, blind + 1)
+        assert not vss.verify_share(x + 1, share, blind)
+
+
+def test_vss_blinds_fresh_per_context():
+    # same seed + chunk but different round context must produce different
+    # blinds and different commitments (blind reuse across rounds would let
+    # commitment differencing cancel the H term)
+    seed = b"\x31" * 32
+    coeffs = [5, -7, 11]
+    vss_a, blinds_a = cm.vss_commit_chunk(coeffs, seed, 0, context=b"round-1")
+    vss_b, blinds_b = cm.vss_commit_chunk(coeffs, seed, 0, context=b"round-2")
+    assert blinds_a != blinds_b
+    assert vss_a.commitments != vss_b.commitments
+    # both still verify their shares
+    x = 3
+    share = cm.eval_poly(coeffs, x)
+    assert vss_a.verify_share(x, share, cm.eval_poly(blinds_a, x))
+    assert vss_b.verify_share(x, share, cm.eval_poly(blinds_b, x))
+
+
+def test_vss_shares_match_xla_share_matrix():
+    # the host-side VSS prover and the XLA share generator must agree on
+    # share values — same polynomial, same x points
+    q = jnp.asarray(np.array([7, -2, 3, 0, 11, 5, -9, 1, 4, 8], np.int64))
+    total = 20
+    shares = np.asarray(ss.make_shares(q, total_shares=total))  # [S, 1]
+    xs = np.asarray(ss.share_xs(total))
+    coeffs = [int(v) for v in np.asarray(q)]
+    for s in range(total):
+        assert shares[s, 0] == cm.eval_poly(coeffs, int(xs[s]))
+
+
+def test_full_pipeline_commit_share_verify_recover():
+    rng = np.random.default_rng(7)
+    d = 25
+    peers = 3
+    deltas = rng.normal(0, 0.2, size=(peers, d))
+    key = cm.CommitKey.generate(d)
+    total = 20
+
+    agg = None
+    for pid in range(peers):
+        q = ss.quantize(jnp.asarray(deltas[pid]))
+        qn = np.asarray(q)
+        c = cm.commit_update(qn, key)
+        assert cm.verify_commitment(c, qn, key)
+        shares = ss.make_shares(q, total_shares=total)
+        # spot-check one chunk's shares against its VSS commitments
+        seed = bytes([pid]) * 32
+        chunk0 = [int(v) for v in np.asarray(ss.to_chunks(q))[0]]
+        vss, blinds = cm.vss_commit_chunk(chunk0, seed, 0)
+        x0 = int(np.asarray(ss.share_xs(total))[0])
+        assert vss.verify_share(
+            x0, int(np.asarray(shares)[0, 0]), cm.eval_poly(blinds, x0)
+        )
+        agg = shares if agg is None else agg + shares
+
+    rec = ss.recover_update(agg, ss.share_xs(total), num_params=d)
+    expected = np.sum(np.trunc(deltas * 1e4) / 1e4, axis=0)
+    assert np.allclose(np.asarray(rec), expected, atol=1e-9)

@@ -1,0 +1,37 @@
+"""Keygen dealer CLI round-trip (ref parity: keyGeneration artifacts read
+back at node startup, honest.go:760-871)."""
+
+import json
+
+from biscotti_tpu.crypto import ed25519 as ed
+from biscotti_tpu.crypto.vrf import VRFKey, verify as vrf_verify
+from biscotti_tpu.tools import keygen
+
+
+def test_generate_and_load_roundtrip(tmp_path):
+    out = str(tmp_path / "keys")
+    keygen.generate(dims=16, nodes=4, out_dir=out, base_port=9000)
+
+    key = keygen.load_commit_key(out)
+    assert len(key.points) == 16
+
+    nodes = keygen.load_node_keys(out)
+    assert set(nodes) == {"0", "1", "2", "3"}
+    # published publics must match the seeds
+    n0 = nodes["0"]
+    assert ed.public_key(bytes.fromhex(n0["schnorr_seed"])).hex() == n0["schnorr_pub"]
+    vk = VRFKey(bytes.fromhex(n0["vrf_noise_seed"]))
+    assert vk.public.hex() == n0["vrf_noise_pub"]
+    beta, pi = vk.prove(b"x")
+    assert vrf_verify(bytes.fromhex(n0["vrf_noise_pub"]), b"x", pi) == beta
+
+    peers = keygen.load_peers(out)
+    assert peers == [f"127.0.0.1:{9000+i}" for i in range(4)]
+
+
+def test_cli_main(tmp_path, capsys):
+    out = str(tmp_path / "k2")
+    rc = keygen.main(["--dims", "8", "--nodes", "2", "--out", out])
+    assert rc == 0
+    data = json.load(open(f"{out}/commit_key.json"))
+    assert data["dims"] == 8 and len(data["points"]) == 8

@@ -1,0 +1,57 @@
+"""Native C++ MSM parity tests (skipped until `make -C native` has run —
+CI/driver boxes build it; the pure-Python fallback keeps everything green
+without it)."""
+
+import random
+
+import pytest
+
+from biscotti_tpu.crypto import commitments as cm
+from biscotti_tpu.crypto import ed25519 as ed
+
+try:
+    from biscotti_tpu.crypto import _native
+
+    HAVE_NATIVE = _native.available()
+except ImportError:  # pragma: no cover
+    HAVE_NATIVE = False
+
+pytestmark = pytest.mark.skipif(not HAVE_NATIVE,
+                                reason="native library not built")
+
+KEY = cm.CommitKey.generate(48)
+
+
+def test_native_matches_python_random():
+    rng = random.Random(42)
+    for _ in range(3):
+        scalars = [rng.randrange(-10**13, 10**13) for _ in range(48)]
+        assert ed.point_equal(
+            _native.msm(scalars, KEY.points),
+            cm._msm_python(scalars, KEY.points),
+        )
+
+
+def test_native_edge_cases():
+    n = len(KEY.points)
+    assert ed.is_identity(_native.msm([0] * n, KEY.points))
+    assert ed.is_identity(_native.msm([], []))
+    one_hot = [0] * n
+    one_hot[7] = 1
+    assert ed.point_equal(_native.msm(one_hot, KEY.points), KEY.points[7])
+    # scalar at the group order collapses to zero
+    one_hot[7] = ed.Q
+    assert ed.is_identity(_native.msm(one_hot, KEY.points))
+    # top-half scalars (negatives) round-trip through point negation
+    s = [ed.Q - 5, 5] + [0] * (n - 2)
+    assert ed.point_equal(_native.msm(s, KEY.points),
+                          cm._msm_python(s, KEY.points))
+
+
+def test_commit_update_uses_native_transparently():
+    import numpy as np
+
+    q = np.array([123456, -654321, 0, 42] * 12, dtype=np.int64)
+    c = cm.commit_update(q, KEY)  # routed through native when available
+    pt = cm._msm_python([int(v) for v in q], KEY.points)
+    assert c == ed.point_compress(pt)

@@ -1,0 +1,96 @@
+"""Stage-6a tests: quantize/share/aggregate/recover round-trips mirroring the
+reference's kyber-demo exercise (ref: kyber-demo/kyber.go:84-643, the
+commented round-trip in DistSys/kyber.go:289-454)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from biscotti_tpu.ops import secretshare as ss
+
+
+def test_quantize_truncates_toward_zero_like_go():
+    d = jnp.asarray([1.23456789, -1.23456789, 0.00004, -0.00004, 0.0])
+    q = ss.quantize(d, precision=4)
+    # Go: int64(x * 10^4) truncates toward zero (ref: kyber.go:698-710)
+    assert q.tolist() == [12345, -12345, 0, 0, 0]
+    back = ss.dequantize(q, precision=4)
+    assert np.allclose(back, [1.2345, -1.2345, 0.0, 0.0, 0.0])
+
+
+def test_total_shares_formula():
+    # TOTAL_SHARES = ceil(2·POLY_SIZE/M)·M (ref: main.go:825)
+    assert ss.total_shares_for(3, 10) == 21
+    assert ss.total_shares_for(4, 10) == 20
+    assert ss.total_shares_for(7, 10) == 21
+
+
+def test_chunking_pads_and_restores():
+    q = jnp.arange(23, dtype=jnp.int64)
+    c = ss.to_chunks(q, poly_size=10)
+    assert c.shape == (3, 10)
+    assert c[2, 3:].tolist() == [0] * 7
+    assert np.array_equal(ss.from_chunks(c, 23), q)
+
+
+def test_share_recover_roundtrip_exact():
+    rng = np.random.default_rng(0)
+    delta = rng.normal(0, 0.5, size=97)
+    q = ss.quantize(jnp.asarray(delta))
+    shares = ss.make_shares(q, total_shares=20)
+    assert shares.shape == (20, 10)
+    xs = ss.share_xs(20)
+    rec = ss.recover_update(shares, xs, num_params=97)
+    assert np.allclose(np.asarray(rec), np.trunc(delta * 1e4) / 1e4)
+
+
+def test_homomorphic_aggregation_recovers_sum():
+    rng = np.random.default_rng(1)
+    peers = 7
+    d = 53
+    deltas = rng.normal(0, 0.3, size=(peers, d))
+    qs = jnp.stack([ss.quantize(jnp.asarray(x)) for x in deltas])
+    all_shares = jnp.stack([ss.make_shares(q, total_shares=20) for q in qs])
+    agg = ss.aggregate_shares(all_shares)
+    xs = ss.share_xs(20)
+    rec = ss.recover_update(agg, xs, num_params=d)
+    expected = np.sum(np.trunc(deltas * 1e4) / 1e4, axis=0)
+    assert np.allclose(np.asarray(rec), expected, atol=1e-9)
+
+
+def test_miner_slices_partition_and_suffice():
+    # miners hold disjoint contiguous row-slices that cover all shares
+    # (ref: kyber.go:205-242); recovery works from the reassembled slices
+    rng = np.random.default_rng(2)
+    num_miners = 3
+    total = ss.total_shares_for(num_miners)  # 21
+    q = ss.quantize(jnp.asarray(rng.normal(0, 1, size=31)))
+    shares = ss.make_shares(q, total_shares=total)
+    xs = ss.share_xs(total)
+    rows = [ss.miner_rows(total, m, num_miners) for m in range(num_miners)]
+    covered = sorted(i for r in rows for i in range(r.start, r.stop))
+    assert covered == list(range(total))
+    reassembled = jnp.concatenate([shares[r] for r in rows])
+    xs_re = jnp.concatenate([xs[r] for r in rows])
+    rec = ss.recover_update(reassembled, xs_re, num_params=31)
+    assert np.allclose(np.asarray(rec), np.asarray(ss.dequantize(q)))
+
+
+def test_recovery_needs_enough_shares():
+    # fewer than poly_size shares cannot determine a degree-9 chunk: the
+    # lstsq solution must differ from the truth somewhere
+    rng = np.random.default_rng(3)
+    q = ss.quantize(jnp.asarray(rng.normal(0, 1, size=40)))
+    shares = ss.make_shares(q, total_shares=20)
+    xs = ss.share_xs(20)
+    few = slice(0, 6)
+    rec = ss.recover_update(shares[few], xs[few], num_params=40)
+    assert not np.allclose(np.asarray(rec), np.asarray(ss.dequantize(q)))
+
+
+def test_share_magnitude_within_float64_exact_range():
+    # worst-case share magnitude for PRECISION=4, |delta|<=grad_clip=100,
+    # |x|<=10, degree 9 must stay below 2^53 so the f64 lstsq is faithful
+    worst = sum(100 * 10**4 * 10**j for j in range(10))
+    assert worst < 2**53

@@ -1,0 +1,176 @@
+"""Stage-5 tests: Ed25519 group law, ECVRF prove/verify, stake lottery and
+prime-coded role election (deterministic fixtures in the spirit of the
+reference's vrf_main.go inspection harness; ref: DistSys/vrf_main.go:1-152)."""
+
+import hashlib
+
+import pytest
+
+from biscotti_tpu.crypto import ed25519 as ed
+from biscotti_tpu.crypto.vrf import PROOF_LEN, VRFKey, verify
+from biscotti_tpu.parallel import roles as R
+
+
+# ------------------------------------------------------------------- ed25519
+
+
+def test_base_point_on_curve_and_order():
+    x, y = ed.B_X, ed.B_Y
+    # −x² + y² = 1 + d·x²·y²  (twisted Edwards, a = −1)
+    assert (-x * x + y * y) % ed.P == (1 + ed.D * x * x % ed.P * y * y) % ed.P
+    assert ed.is_identity(ed.scalar_mult(ed.Q, ed.BASE))
+    assert not ed.is_identity(ed.scalar_mult(ed.Q - 1, ed.BASE))
+
+
+def test_group_law_consistency():
+    p2 = ed.point_double(ed.BASE)
+    assert ed.point_equal(p2, ed.point_add(ed.BASE, ed.BASE))
+    # (a + b)·B == a·B + b·B
+    a, b = 12345, 67890
+    lhs = ed.base_mult(a + b)
+    rhs = ed.point_add(ed.base_mult(a), ed.base_mult(b))
+    assert ed.point_equal(lhs, rhs)
+    # P + (−P) = 0
+    assert ed.is_identity(ed.point_add(p2, ed.point_neg(p2)))
+
+
+def test_compress_decompress_roundtrip():
+    for k in (1, 2, 7, 12345, ed.Q - 1):
+        p = ed.base_mult(k)
+        enc = ed.point_compress(p)
+        dec = ed.point_decompress(enc)
+        assert dec is not None and ed.point_equal(p, dec)
+    assert ed.point_decompress(b"\xff" * 32) is None  # y >= p
+    assert ed.point_decompress(b"\x00" * 31) is None  # wrong length
+
+
+def test_rfc8032_public_key_vector():
+    # RFC 8032 §7.1 TEST 1: secret seed -> public key
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    assert ed.public_key(seed).hex() == (
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+
+
+# ----------------------------------------------------------------------- vrf
+
+
+def test_vrf_prove_verify_roundtrip():
+    key = VRFKey(seed=hashlib.sha256(b"peer-3-roles").digest())
+    alpha = hashlib.sha256(b"block-hash-7").digest()
+    beta, pi = key.prove(alpha)
+    assert len(beta) == 64 and len(pi) == PROOF_LEN
+    assert verify(key.public, alpha, pi) == beta
+
+
+def test_vrf_deterministic_and_unique_per_input():
+    key = VRFKey(seed=b"\x11" * 32)
+    b1, p1 = key.prove(b"alpha")
+    b2, p2 = key.prove(b"alpha")
+    assert b1 == b2 and p1 == p2
+    b3, _ = key.prove(b"beta")
+    assert b3 != b1
+
+
+def test_vrf_rejects_forgeries():
+    key = VRFKey(seed=b"\x22" * 32)
+    other = VRFKey(seed=b"\x33" * 32)
+    alpha = b"round-entropy"
+    beta, pi = key.prove(alpha)
+    # wrong key, wrong input, tampered proof, malformed proof
+    assert verify(other.public, alpha, pi) is None
+    assert verify(key.public, b"other-input", pi) is None
+    bad = bytearray(pi)
+    bad[40] ^= 1
+    assert verify(key.public, alpha, bytes(bad)) is None
+    assert verify(key.public, alpha, pi[:-1]) is None
+    assert verify(b"\x00" * 32, alpha, pi) is None
+
+
+# --------------------------------------------------------------------- roles
+
+
+def _stake(n, default=10):
+    return {i: default for i in range(n)}
+
+
+def test_lottery_tickets_proportional():
+    stake = {0: 1, 1: 3, 2: 0}
+    t = R.lottery_tickets(stake, 3)
+    assert t == [0, 1, 1, 1]
+    with pytest.raises(ValueError):
+        R.lottery_tickets({0: 0}, 1)
+
+
+def test_committees_deterministic_across_peers():
+    stake = _stake(10)
+    h = hashlib.sha256(b"latest-block").digest()
+    a = R.elect_committees(stake, h, 3, 3, 10)
+    b = R.elect_committees(stake, h, 3, 3, 10)
+    assert a == b
+    v, m = a
+    assert len(v) == 3 and len(set(v)) == 3
+    assert len(m) == 3 and len(set(m)) == 3
+    # different block hash -> (almost surely) different committees
+    h2 = hashlib.sha256(b"other-block").digest()
+    assert R.elect_committees(stake, h2, 3, 3, 10) != a
+
+
+def test_stake_biases_the_draw():
+    # one node holding ~all stake wins essentially every seat
+    stake = {0: 10_000, 1: 1, 2: 1}
+    wins = 0
+    for r in range(20):
+        h = hashlib.sha256(f"blk{r}".encode()).digest()
+        v, _ = R.elect_committees(stake, h, 1, 0, 3)
+        wins += v[0] == 0
+    assert wins >= 18
+
+
+def test_entropy_exhaustion_rehashes():
+    # 2 bytes of entropy yields exactly one window, then must re-hash;
+    # drawing many distinct winners forces that path
+    t = list(range(50))
+    winners = R.draw_winners(b"\xaa\xbb", [i for i in t for _ in range(1)], 20)
+    assert len(winners) == 20 and len(set(winners)) == 20
+
+
+def test_draw_winners_excludes_and_bounds():
+    tickets = R.lottery_tickets(_stake(5), 5)
+    w = R.draw_winners(b"seed-entropy-string", tickets, 4, exclude=2)
+    assert 2 not in w and len(set(w)) == 4
+    with pytest.raises(ValueError):
+        R.draw_winners(b"seed", tickets, 5, exclude=2)  # only 4 distinct left
+
+
+def test_noiser_draw_verifies_and_binds():
+    stake = _stake(8)
+    h = hashlib.sha256(b"blk").digest()
+    key = VRFKey(seed=b"\x44" * 32)
+    draw = R.elect_noisers(key, stake, h, source_id=1, num_noisers=2,
+                           total_nodes=8)
+    assert 1 not in draw.noisers and len(draw.noisers) == 2
+    assert R.verify_noiser_draw(key.public, stake, h, 1, draw, 8)
+    # a lying requester substituting its favorite noisers fails verification
+    forged = R.NoiserDraw(noisers=[2, 3], output=draw.output, proof=draw.proof)
+    if forged.noisers != draw.noisers:
+        assert not R.verify_noiser_draw(key.public, stake, h, 1, forged, 8)
+    # proof from a different key fails
+    other = VRFKey(seed=b"\x55" * 32)
+    assert not R.verify_noiser_draw(other.public, stake, h, 1, draw, 8)
+
+
+def test_role_map_prime_codec():
+    rm = R.RoleMap.build(6, verifiers=[0, 1], miners=[1, 2], noisers=[3])
+    assert rm.roles[0] == 2 and rm.roles[1] == 6 and rm.roles[2] == 3
+    assert rm.roles[3] == 5 and rm.roles[4] == 1
+    assert rm.is_verifier(0) and rm.is_verifier(1) and not rm.is_verifier(2)
+    assert rm.is_miner(1) and rm.is_miner(2) and not rm.is_miner(3)
+    assert rm.is_noiser(3) and not rm.is_noiser(0)
+    # vanilla = role 1 or noiser-only (ref: main.go:539-541)
+    assert rm.is_vanilla(3) and rm.is_vanilla(4) and not rm.is_vanilla(0)
+    verifiers, miners, noisers, vanilla = rm.committee()
+    assert verifiers == [0, 1]  # sorted, ref main.go:560-562
+    assert set(miners) == {1, 2} and noisers == [3] and vanilla == 3
