@@ -74,6 +74,10 @@ class BiscottiConfig:
     secure_agg: bool = True
     noising: bool = True
     verification: bool = True
+    # FedSys baseline mode: fixed leader (node 0) collects and AVERAGES
+    # updates, no chain crypto/VRF/committees — the reference's separate
+    # FedSys binary as a feature flag (ref: FedSys/main.go, SURVEY §2.5)
+    fedsys: bool = False
 
     # --- privacy / attack (ref flags -ep -po -c, main.go:625,643-647) ---
     epsilon: float = 1.0
@@ -185,6 +189,8 @@ class BiscottiConfig:
         p.add_argument("--max-iterations", type=int, default=100)
         p.add_argument("--fail-prob", type=float, default=0.0)
         p.add_argument("--seed", type=int, default=0)
+        p.add_argument("--fedsys", type=int, default=0,
+                       help="FedSys leader-aggregation baseline mode")
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
@@ -214,6 +220,7 @@ class BiscottiConfig:
             max_iterations=ns.max_iterations,
             fail_prob=ns.fail_prob,
             seed=ns.seed,
+            fedsys=bool(getattr(ns, "fedsys", 0)),
         )
 
     def replace(self, **kw) -> "BiscottiConfig":

@@ -1,0 +1,10 @@
+"""Distributed runtime — the host control plane.
+
+The reference's native layer is a Go binary per peer speaking net/rpc+gob
+over TCP (SURVEY.md §1 comms, §2.1). Here the control plane is an asyncio
+peer agent (`peer.py`) over a length-prefixed binary codec (`messages.py`,
+`rpc.py`); all round *math* (SGD, noising, Krum, share algebra) stays in
+jitted XLA via the Trainer/ops layers. FedSys (the reference's baseline
+system, SURVEY.md §2.5) is the same runtime in leader-aggregation mode —
+a config flag, not a second codebase.
+"""

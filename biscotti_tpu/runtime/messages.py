@@ -1,0 +1,102 @@
+"""Wire codec: length-prefixed frames carrying a JSON header + raw ndarray
+payloads.
+
+Replaces the reference's encoding/gob (ref: DistSys/main.go:609-610 gob type
+registration; kyber points marshalled to []byte for the wire,
+kyber.go:88-168). Dense float/int arrays — the bulk of every message — ride
+as raw little-endian bytes after the header, so a d=7,850 update costs
+~63 KB, not a JSON blow-up; everything else (ids, iterations, commitments as
+hex) is JSON. No pickle anywhere: peers are untrusted
+(Byzantine model), and the decoder only materialises declared dtypes/shapes.
+
+Frame:    [u32 BE frame_len][payload]
+Payload:  [u32 BE header_len][header JSON][array bytes …]
+Header:   {"type": str, "meta": {...}, "arrays": [{"name","dtype","shape"}]}
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+MAX_FRAME = 256 * 1024 * 1024  # hard cap against hostile length prefixes
+
+_ALLOWED_DTYPES = {"float32", "float64", "int32", "int64", "uint8", "bool"}
+
+
+class CodecError(ValueError):
+    pass
+
+
+def encode(msg_type: str, meta: Dict[str, Any] | None = None,
+           arrays: Dict[str, np.ndarray] | None = None) -> bytes:
+    meta = meta or {}
+    arrays = arrays or {}
+    descs = []
+    blobs = []
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        if arr.dtype.name not in _ALLOWED_DTYPES:
+            raise CodecError(f"dtype {arr.dtype} not allowed on the wire")
+        descs.append({"name": name, "dtype": arr.dtype.name,
+                      "shape": list(arr.shape)})
+        blobs.append(arr.tobytes())
+    header = json.dumps({"type": msg_type, "meta": meta, "arrays": descs},
+                        separators=(",", ":")).encode()
+    payload = struct.pack(">I", len(header)) + header + b"".join(blobs)
+    if len(payload) + 4 > MAX_FRAME:
+        raise CodecError("frame too large")
+    return struct.pack(">I", len(payload)) + payload
+
+
+def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
+    """Decode one frame payload (the bytes after the frame-length prefix).
+    Raises CodecError on any malformation — a Byzantine peer must not be
+    able to crash an honest one with a bad frame."""
+    try:
+        if len(payload) < 4:
+            raise CodecError("short frame")
+        (hlen,) = struct.unpack(">I", payload[:4])
+        if hlen > len(payload) - 4:
+            raise CodecError("header length exceeds frame")
+        header = json.loads(payload[4 : 4 + hlen].decode())
+        msg_type = header["type"]
+        meta = header.get("meta", {})
+        if not isinstance(msg_type, str) or not isinstance(meta, dict):
+            raise CodecError("malformed header")
+        arrays: Dict[str, np.ndarray] = {}
+        off = 4 + hlen
+        for desc in header.get("arrays", []):
+            dtype = desc["dtype"]
+            if dtype not in _ALLOWED_DTYPES:
+                raise CodecError(f"dtype {dtype} not allowed")
+            shape = tuple(int(s) for s in desc["shape"])
+            if any(s < 0 for s in shape):
+                raise CodecError("negative dim")
+            count = int(np.prod(shape)) if shape else 1
+            nbytes = count * np.dtype(dtype).itemsize
+            if off + nbytes > len(payload):
+                raise CodecError("array bytes exceed frame")
+            arrays[desc["name"]] = np.frombuffer(
+                payload[off : off + nbytes], dtype=dtype
+            ).reshape(shape).copy()
+            off += nbytes
+        return msg_type, meta, arrays
+    except CodecError:
+        raise
+    except Exception as e:  # json errors, missing keys, bad shapes …
+        raise CodecError(f"bad frame: {e}") from e
+
+
+async def read_frame(reader) -> bytes:
+    """Read one frame payload from an asyncio StreamReader."""
+    import asyncio  # local import keeps the codec importable without asyncio
+
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack(">I", hdr)
+    if n > MAX_FRAME:
+        raise CodecError("frame length exceeds cap")
+    return await reader.readexactly(n)

@@ -1,0 +1,788 @@
+"""The peer agent — per-host protocol state machine.
+
+One asyncio process per peer, replacing the reference's Go binary
+(ref: DistSys/main.go). The RPC surface is the reference's nine `Peer`
+methods (SURVEY.md §2.1 row 2); round math (SGD step, DP noise, Krum/RONI,
+share algebra) dispatches to the jitted XLA Trainer/ops layers; EC crypto
+(commitments, Schnorr, VRF) runs on the host via biscotti_tpu.crypto.
+
+Round choreography (ref: SURVEY.md §3):
+  worker   : compute update → noise from noisers → verifier signatures →
+             shares to miners (secure-agg) or update to miners (plain)
+  verifier : collect updates to threshold → Krum/RONI on device → release
+             parked callers with signatures / rejections
+  miner    : collect updates|shares → leader mints block at deadline →
+             broadcast; everyone holds an empty-block fallback timer so the
+             round ALWAYS advances (ref: main.go:2099-2143)
+  noiser   : serve presampled DP noise (ref: honest.go:564-592)
+
+FedSys mode (cfg.fedsys): fixed leader node 0, no committees/crypto, deltas
+AVERAGED not summed (ref: FedSys/honest.go:311) — the baseline system as a
+config flag.
+
+Single-threaded asyncio replaces the reference's goroutine+mutex web: every
+state transition happens on the event loop, so rounds are linearizable by
+construction (the races patched ad-hoc in the reference, e.g.
+main.go:1481-1482, cannot occur).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from biscotti_tpu.config import BiscottiConfig, Defense
+from biscotti_tpu.crypto import commitments as cm
+from biscotti_tpu.crypto.vrf import VRFKey
+from biscotti_tpu.data import datasets as ds
+from biscotti_tpu.ledger.block import Block, BlockData, Update
+from biscotti_tpu.ledger.chain import Blockchain
+from biscotti_tpu.models.trainer import Trainer
+from biscotti_tpu.ops import secretshare as ss
+from biscotti_tpu.parallel import roles as R
+from biscotti_tpu.parallel.sim import _poisoned_ids
+from biscotti_tpu.runtime import rpc, wire
+from biscotti_tpu.runtime.rpc import RPCError, StaleError
+from biscotti_tpu.tools import keygen
+
+
+@dataclass
+class RoundState:
+    """Everything scoped to one iteration; rebuilt on every round
+    transition (the reference's flushUpdates/flushSecrets,
+    ref: main.go:1096-1107)."""
+
+    iteration: int
+    verifier_pool: List[Update] = field(default_factory=list)
+    verifier_sources: Set[int] = field(default_factory=set)
+    krum_decision: Optional[asyncio.Future] = None
+    miner_updates: Dict[int, Update] = field(default_factory=dict)
+    miner_shares: Dict[int, np.ndarray] = field(default_factory=dict)
+    miner_commitments: Dict[int, bytes] = field(default_factory=dict)
+    block_done: Optional[asyncio.Event] = None
+    tasks: List[asyncio.Task] = field(default_factory=list)
+
+
+class PeerAgent:
+    def __init__(self, cfg: BiscottiConfig, key_dir: str = "",
+                 log_path: str = ""):
+        self.cfg = cfg
+        self.id = cfg.node_id
+        self.converged = False
+        self.total_updates = 0
+
+        poisoned = _poisoned_ids(cfg.num_nodes, cfg.poison_fraction)
+        shard = ds.shard_name(cfg.dataset, self.id, self.id in poisoned)
+        self.trainer = Trainer(cfg.dataset, shard, cfg=cfg, seed=self.id)
+        self.chain = Blockchain(self.trainer.num_params, cfg.num_nodes,
+                                cfg.default_stake)
+
+        # peers: id -> (host, port); file format = host:port per line
+        # (ref: peersfile.txt, README.md:49-66)
+        self.peers: Dict[int, Tuple[str, int]] = {}
+        if cfg.peers_file:
+            with open(cfg.peers_file) as f:
+                for i, addr in enumerate(a.strip() for a in f if a.strip()):
+                    host, port = addr.rsplit(":", 1)
+                    self.peers[i] = (host, int(port))
+        else:
+            for i in range(cfg.num_nodes):
+                self.peers[i] = (cfg.my_ip, cfg.port_of(i))
+        # membership: evicted peers stop receiving RPCs but keep their slot
+        # in the id space (ref: main.go:1479-1482 — peerLookup never shrinks)
+        self.alive: Set[int] = set(self.peers)
+
+        # identity keys: from the dealer when provided, else derived
+        # deterministically from (seed, id) so local tests need no keygen
+        if key_dir:
+            all_keys = keygen.load_node_keys(key_dir)
+            keys = all_keys[str(self.id)]
+            self.schnorr_seed = bytes.fromhex(keys["schnorr_seed"])
+            self.noise_vrf = VRFKey(bytes.fromhex(keys["vrf_noise_seed"]))
+            self.node_pubs = {
+                int(i): bytes.fromhex(k["schnorr_pub"])
+                for i, k in all_keys.items()
+            }
+            self.commit_key = keygen.load_commit_key(key_dir)
+        else:
+            self.schnorr_seed = hashlib.sha256(
+                f"schnorr-{cfg.seed}-{self.id}".encode()).digest()
+            self.noise_vrf = VRFKey(hashlib.sha256(
+                f"vrf-noise-{cfg.seed}-{self.id}".encode()).digest())
+            self.node_pubs = {
+                i: hashlib.sha256(f"schnorr-{cfg.seed}-{i}".encode()).digest()
+                for i in range(cfg.num_nodes)
+            }  # placeholder publics; real deployments pass key_dir
+            self.commit_key = None
+
+        self.timeouts = cfg.timeouts  # already-scaled instance may be passed
+        self.server = rpc.RPCServer(cfg.my_ip, cfg.port_of(self.id),
+                                    self._handle)
+        self.round = RoundState(iteration=self.chain.next_iteration)
+        self.role_map = R.RoleMap({i: 1 for i in range(cfg.num_nodes)})
+        self.logs: List[Tuple[int, float, float]] = []  # iter, err, ts
+        self._log_path = log_path
+        self._events = open(log_path, "a") if log_path else None
+        self._rng = random.Random(cfg.seed * 7919 + self.id)
+        # strong refs to fire-and-forget tasks: the loop only keeps weak
+        # references, so an unreferenced parked task can be GC'd mid-sleep
+        self._bg_tasks: Set[asyncio.Task] = set()
+
+    # ------------------------------------------------------------ utilities
+
+    @property
+    def iteration(self) -> int:
+        return self.chain.next_iteration
+
+    def _trace(self, event: str, **kw) -> None:
+        """Structured per-round event log (SURVEY.md §5.1: the TPU build's
+        replacement for the reference's timestamped text logs)."""
+        if self._events:
+            rec = {"ts": time.time(), "node": self.id,
+                   "iter": self.iteration, "event": event, **kw}
+            self._events.write(json.dumps(rec) + "\n")
+            self._events.flush()
+
+    def _sign(self, message: bytes) -> bytes:
+        return cm.schnorr_sign(self.schnorr_seed, message)
+
+    def _commit(self, q: np.ndarray) -> bytes:
+        if self.commit_key is not None:
+            return cm.commit_update(q, self.commit_key)
+        # keyless local mode: binding-only hash commitment
+        return hashlib.sha256(q.tobytes()).digest()
+
+    async def _call(self, peer_id: int, msg_type: str, meta=None, arrays=None,
+                    timeout: Optional[float] = None):
+        """RPC with the reference's timeout-evict semantics
+        (ref: main.go:1460-1487)."""
+        host, port = self.peers[peer_id]
+        try:
+            return await rpc.call(host, port, msg_type, meta, arrays,
+                                  timeout or self.timeouts.rpc_s)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            self.alive.discard(peer_id)
+            raise
+
+    # --------------------------------------------------------------- roles
+
+    def _compute_roles(self) -> None:
+        """Role election for the current iteration (ref: main.go:497-527).
+        FedSys: node 0 is the eternal miner (ref: FedSys/main.go:758-768)."""
+        cfg = self.cfg
+        if cfg.fedsys:
+            self.role_map = R.RoleMap.build(cfg.num_nodes, verifiers=[],
+                                            miners=[0], noisers=[])
+            return
+        stake = self.chain.latest_stake_map()
+        verifiers, miners = R.elect_committees(
+            stake, self.chain.latest_hash(), cfg.num_verifiers,
+            cfg.num_miners, cfg.num_nodes)
+        self.role_map = R.RoleMap.build(cfg.num_nodes, verifiers, miners)
+
+    def _my_noisers(self) -> List[int]:
+        draw = R.elect_noisers(
+            self.noise_vrf, self.chain.latest_stake_map(),
+            self.chain.latest_hash(), self.id, self.cfg.num_noisers,
+            self.cfg.num_nodes)
+        return draw.noisers
+
+    # ---------------------------------------------------------- RPC surface
+
+    async def _handle(self, msg_type, meta, arrays):
+        dispatch = {
+            "RegisterPeer": self._h_register_peer,
+            "RegisterBlock": self._h_register_block,
+            "RegisterUpdate": self._h_register_update,
+            "RegisterSecret": self._h_register_secret,
+            "RequestNoise": self._h_request_noise,
+            "VerifyUpdateKRUM": self._h_verify_update,
+            "VerifyUpdateRONI": self._h_verify_update,
+            "GetUpdateList": self._h_get_update_list,
+            "GetMinerPart": self._h_get_miner_part,
+        }
+        h = dispatch.get(msg_type)
+        if h is None:
+            raise RPCError(f"unknown method {msg_type}")
+        return await h(meta, arrays)
+
+    async def _wait_for_iteration(self, it: int, budget: float = 30.0) -> None:
+        """Park a future-iteration message until we catch up
+        (ref: main.go:1211-1214, krum.go:240-243)."""
+        deadline = time.monotonic() + budget
+        while self.iteration < it:
+            if time.monotonic() > deadline:
+                raise RPCError("caller too far ahead")
+            await asyncio.sleep(0.05)
+
+    async def _wait_round_ready(self, it: int, budget: float = 30.0) -> RoundState:
+        """Park until OUR round state for iteration `it` exists — callers may
+        race ahead of a peer that is still bootstrapping or mid-transition
+        (the reference blocks such callers the same way, krum.go:240-243).
+        Returns the ready RoundState; raises StaleError if we are already
+        past `it`."""
+        await self._wait_for_iteration(it, budget)
+        deadline = time.monotonic() + budget
+        while True:
+            if self.iteration > it:
+                raise StaleError()
+            st = self.round
+            if st.iteration == it and st.krum_decision is not None:
+                return st
+            if time.monotonic() > deadline:
+                raise RPCError("round never became ready")
+            await asyncio.sleep(0.02)
+
+    async def _h_register_peer(self, meta, arrays):
+        """Join/announce: record the caller, return our chain so they can
+        adopt the longest one (ref: main.go:950-1024)."""
+        pid = int(meta["source_id"])
+        if "host" in meta and "port" in meta:
+            self.peers[pid] = (meta["host"], int(meta["port"]))
+        self.alive.add(pid)
+        cmeta, carrays = wire.pack_chain(self.chain.blocks)
+        return cmeta, carrays
+
+    async def _h_register_block(self, meta, arrays):
+        blk = wire.unpack_block(meta, arrays)
+        self._accept_block(blk, gossip=True)
+        return {}, {}
+
+    def _accept_block(self, blk: Block, gossip: bool) -> None:
+        if blk.iteration > self.iteration:
+            # future block: we're behind — park it and retry as we catch up
+            # (ref: main.go:1300-1320 sleep-loop)
+            t = asyncio.get_running_loop().create_task(self._late_accept(blk))
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
+            return
+        changed = self.chain.consider_block(blk)
+        if changed:
+            self._trace("block_accepted", height=blk.iteration,
+                        empty=blk.is_empty(), hash=blk.hash.hex()[:16])
+            if self.round.block_done and blk.iteration >= self.round.iteration:
+                self.round.block_done.set()
+            if gossip:
+                self._gossip_block(blk)
+
+    async def _late_accept(self, blk: Block, budget: float = 20.0) -> None:
+        deadline = time.monotonic() + budget
+        while self.iteration < blk.iteration and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if blk.iteration <= self.iteration:
+            self._accept_block(blk, gossip=False)
+
+    def _gossip_block(self, blk: Block) -> None:
+        """Re-gossip on append (ref: main.go:1390,1410-1418)."""
+        meta, arrays = wire.pack_block(blk)
+
+        async def send(pid):
+            try:
+                await self._call(pid, "RegisterBlock", meta, arrays,
+                                 timeout=self.timeouts.rpc_s)
+            except Exception:
+                pass
+
+        for pid in list(self.alive):
+            if pid != self.id:
+                t = asyncio.get_running_loop().create_task(send(pid))
+                self.round.tasks.append(t)
+
+    async def _h_register_update(self, meta, arrays):
+        """Miner intake, plain mode (ref: main.go:420-436)."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        st = await self._wait_round_ready(it)
+        if not self.role_map.is_miner(self.id):
+            raise RPCError("not a miner this round")
+        u = wire.unpack_update(meta, arrays)
+        if len(u.delta) != self.trainer.num_params:
+            raise RPCError("bad update dimension")
+        st.miner_updates.setdefault(u.source_id, u)
+        self._trace("update_registered", source=u.source_id,
+                    have=len(st.miner_updates))
+        return {}, {}
+
+    async def _h_register_secret(self, meta, arrays):
+        """Miner intake, secure-agg mode: one share-row slice per
+        contributor (ref: main.go:256-286, 330-367)."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        st = await self._wait_round_ready(it)
+        if not self.role_map.is_miner(self.id):
+            raise RPCError("not a miner this round")
+        sid = int(meta["source_id"])
+        rows = np.asarray(arrays["share_rows"], dtype=np.int64)
+        expect = (self.cfg.shares_per_miner,
+                  ss.num_chunks(self.trainer.num_params, self.cfg.poly_size))
+        if rows.shape != expect:
+            raise RPCError(f"bad share shape {rows.shape} != {expect}")
+        st.miner_shares.setdefault(sid, rows)
+        st.miner_commitments[sid] = bytes.fromhex(meta.get("commitment", ""))
+        self._trace("secret_registered", source=sid,
+                    have=len(st.miner_shares))
+        return {}, {}
+
+    async def _h_request_noise(self, meta, arrays):
+        """Noiser serving its presampled DP noise for the round
+        (ref: main.go:239-248 → honest.go:564-592)."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        noise = self.trainer.get_noise(it)
+        return {}, {"noise": noise}
+
+    async def _h_verify_update(self, meta, arrays):
+        """Verifier: park until the round's defense decision resolves, then
+        sign or reject (ref: DistSys/krum.go:227-365)."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        st = await self._wait_round_ready(it)
+        if not self.role_map.is_verifier(self.id):
+            raise RPCError("not a verifier this round")
+        u = wire.unpack_update(meta, arrays)
+        vec = u.noised_delta if u.noised_delta is not None else u.delta
+        if vec is None or len(vec) != self.trainer.num_params:
+            raise RPCError("bad update dimension")
+        if u.source_id not in st.verifier_sources:
+            st.verifier_sources.add(u.source_id)
+            st.verifier_pool.append(u)
+            self._trace("verify_request", source=u.source_id,
+                        pool=len(st.verifier_pool),
+                        thresh=self.cfg.krum_update_thresh)
+            if len(st.verifier_pool) >= self.cfg.krum_update_thresh:
+                self._decide_round()
+        accepted = await asyncio.wait_for(
+            asyncio.shield(st.krum_decision), self.timeouts.krum_s * 2)
+        if u.source_id in accepted:
+            sig = self._sign(u.commitment or u.delta.tobytes())
+            return {"signature": sig.hex()}, {}
+        raise RPCError("rejected by defense")
+
+    def _decide_round(self) -> None:
+        """Run the defense over the collected pool and release every parked
+        caller (ref: krum.go:296-336). Colluding poisoners on the committee
+        rubber-stamp each other (ref: krum.go:47-58)."""
+        st = self.round
+        if st.krum_decision is None or st.krum_decision.done():
+            return
+        pool = sorted(st.verifier_pool, key=lambda u: u.source_id)
+        if self.cfg.krum_sample_size and len(pool) > self.cfg.krum_sample_size:
+            rng = random.Random(st.iteration)  # deterministic, ref krum.go:370
+            pool = sorted(rng.sample(pool, self.cfg.krum_sample_size),
+                          key=lambda u: u.source_id)
+        accepted: Set[int] = set()
+        if pool:
+            import jax.numpy as jnp
+
+            from biscotti_tpu.ops.krum import default_num_adversaries, krum_accept_mask
+            from biscotti_tpu.ops.roni import roni_accept_mask
+
+            vecs = np.stack([
+                u.noised_delta if u.noised_delta is not None else u.delta
+                for u in pool
+            ])
+            if self.cfg.defense == Defense.KRUM and len(pool) > 2:
+                mask = np.asarray(krum_accept_mask(
+                    jnp.asarray(vecs, jnp.float32),
+                    default_num_adversaries(len(pool))))
+            elif self.cfg.defense == Defense.RONI:
+                mask = np.asarray(roni_accept_mask(
+                    self.trainer.model,
+                    jnp.asarray(self.chain.latest_gradient(), jnp.float32),
+                    jnp.asarray(vecs, jnp.float32),
+                    self.trainer.x_test, self.trainer.y_test,
+                    self.cfg.roni_threshold))
+            else:
+                mask = np.ones(len(pool), dtype=bool)
+            accepted = {u.source_id for u, m in zip(pool, mask) if m}
+        from biscotti_tpu.ops.krum import collusion_accept_override
+
+        if collusion_accept_override(self.id, self.cfg.num_nodes,
+                                     self.cfg.poison_fraction):
+            poisoners = _poisoned_ids(self.cfg.num_nodes,
+                                      self.cfg.poison_fraction)
+            accepted |= {u.source_id for u in st.verifier_pool
+                         if u.source_id in poisoners}
+        self._trace("defense_decided", pool=len(pool),
+                    accepted=sorted(accepted))
+        st.krum_decision.set_result(accepted)
+
+    async def _h_get_update_list(self, meta, arrays):
+        """Leader-miner asks which sources this miner holds shares for
+        (ref: main.go:438-457, 2237-2277)."""
+        it = int(meta["iteration"])
+        st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
+        srcs = sorted(st.miner_shares)
+        return {"sources": srcs}, {}
+
+    async def _h_get_miner_part(self, meta, arrays):
+        """Leader-miner collects this miner's share slice, aggregated over
+        the agreed node list (ref: main.go:459-485, kyber.go:244-287)."""
+        it = int(meta["iteration"])
+        st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
+        nodes = [int(x) for x in meta["nodes"]]
+        if not all(n in st.miner_shares for n in nodes):
+            raise RPCError("missing shares for requested nodes")
+        stack = np.stack([st.miner_shares[n] for n in nodes])
+        agg = np.asarray(ss.aggregate_shares(stack))
+        return {"nodes": nodes}, {"agg_rows": agg}
+
+    # --------------------------------------------------------------- worker
+
+    async def _worker_flow(self) -> None:
+        cfg = self.cfg
+        it = self.iteration
+        st = self.round
+        w = self.chain.latest_gradient()
+        # heavy device call off the event loop: in-process clusters share one
+        # loop, and a blocked loop starves every peer's timers
+        delta = await asyncio.to_thread(self.trainer.private_fun, w, it)
+        self.total_updates += 1
+
+        noise = None
+        if cfg.dp_in_model:
+            delta = delta + self.trainer.get_noise(it)
+        noised = delta
+        if cfg.noising and not cfg.fedsys:
+            vectors = []
+            for nid in self._my_noisers():
+                if nid == self.id:
+                    vectors.append(self.trainer.get_noise(it))
+                    continue
+                try:
+                    _, arrs = await self._call(nid, "RequestNoise",
+                                               {"iteration": it})
+                    vectors.append(np.asarray(arrs["noise"], np.float64))
+                except Exception:
+                    continue
+            if vectors:
+                noise = np.mean(vectors, axis=0)
+                noised = delta + noise
+
+        q = np.asarray(ss.quantize(np.asarray(delta)))
+        commitment = self._commit(q)
+        u = Update(source_id=self.id, iteration=it, delta=delta,
+                   commitment=commitment, noise=noise, noised_delta=noised)
+
+        approved = True
+        if cfg.verification and not cfg.fedsys:
+            verifiers, _, _, _ = self.role_map.committee()
+            # verifiers see ONLY the noised copy + commitment: the raw delta
+            # is exactly what DP noising and share-based aggregation hide
+            # (ref: SURVEY §2.3 row 21 — NoisedDelta to verifiers, Delta to
+            # miners)
+            redacted = Update(source_id=self.id, iteration=it,
+                              delta=np.zeros(0, np.float64),
+                              commitment=commitment, noised_delta=noised)
+            meta, arrays = wire.pack_update(redacted)
+            sigs = []
+
+            async def ask(v):
+                try:
+                    rmeta, _ = await self._call(
+                        v, "VerifyUpdateKRUM" if cfg.defense == Defense.KRUM
+                        else "VerifyUpdateRONI", meta, arrays,
+                        timeout=self.timeouts.krum_s * 2 + self.timeouts.rpc_s)
+                    sigs.append(bytes.fromhex(rmeta["signature"]))
+                except Exception as e:
+                    self._trace("verify_call_failed", verifier=v,
+                                error=f"{type(e).__name__}: {e}")
+
+            await asyncio.gather(*(ask(v) for v in verifiers))
+            # approved iff ≥ half the verifiers signed (ref: main.go:1686)
+            approved = len(sigs) >= max(1, (len(verifiers) + 1) // 2)
+            u.signatures = sigs
+        if not approved:
+            self._trace("update_rejected")
+            return
+
+        _, miners, _, _ = self.role_map.committee()
+        if cfg.secure_agg and not cfg.fedsys:
+            shares = np.asarray(ss.make_shares(
+                np.asarray(q), cfg.poly_size, cfg.total_shares))
+            for idx, m in enumerate(sorted(miners)):
+                rows = shares[ss.miner_rows(cfg.total_shares, idx,
+                                            len(miners))]
+                try:
+                    await self._call(m, "RegisterSecret", {
+                        "iteration": it, "source_id": self.id,
+                        "miner_index": idx,
+                        "commitment": commitment.hex(),
+                    }, {"share_rows": rows})
+                except Exception:
+                    pass
+        else:
+            meta, arrays = wire.pack_update(u)
+            meta["iteration"] = it
+            # send to every miner: only the leader (max id) mints, so the
+            # update must reach it (the reference's first-miner-wins race,
+            # main.go:1777-1845, maps onto our single-leader mint)
+            await asyncio.gather(*(
+                self._safe_call(m, "RegisterUpdate", meta, arrays)
+                for m in sorted(miners)
+            ))
+        self._trace("update_sent", secure_agg=cfg.secure_agg)
+
+    async def _safe_call(self, pid, msg_type, meta=None, arrays=None) -> bool:
+        try:
+            await self._call(pid, msg_type, meta, arrays)
+            return True
+        except Exception:
+            return False
+
+    # ---------------------------------------------------------------- miner
+
+    def _miner_leader(self, miners: List[int]) -> int:
+        """Leader = max node id among miners (ref: main.go:2027-2045)."""
+        return max(miners)
+
+    async def _miner_flow(self) -> None:
+        cfg = self.cfg
+        it = self.iteration
+        st = self.round
+        _, miners, _, _ = self.role_map.committee()
+        sec = cfg.secure_agg and not cfg.fedsys
+        deadline = self.timeouts.share_s if sec else self.timeouts.update_s
+        # secure-agg triggers at NUM_SAMPLES/2 shares (ref: main.go:345-363);
+        # plain/FedSys waits for the full sample count (ref: FedSys/main.go:530-558)
+        target = max(1, cfg.num_samples // 2) if sec else max(1, cfg.num_samples)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            have = len(st.miner_shares) if sec else len(st.miner_updates)
+            if have >= target:
+                break
+            if st.block_done and st.block_done.is_set():
+                return  # someone else minted first
+            await asyncio.sleep(0.05)
+        if self.id != self._miner_leader(miners):
+            return  # non-leader miners rely on the block timer fallback
+        if st.block_done and st.block_done.is_set():
+            return
+        blk = await self._create_block()
+        if blk is not None:
+            self._accept_block(blk, gossip=True)
+
+    async def _create_block(self) -> Optional[Block]:
+        cfg = self.cfg
+        st = self.round
+        it = self.iteration
+        w = self.chain.latest_gradient()
+        stake = self.chain.latest_stake_map()
+
+        if cfg.secure_agg and not cfg.fedsys:
+            _, miners, _, _ = self.role_map.committee()
+            miners = sorted(miners)
+            # 1. agree on the contributor set: intersection across miners
+            node_sets = [set(self.round.miner_shares)]
+            for m in miners:
+                if m == self.id:
+                    continue
+                try:
+                    rmeta, _ = await self._call(m, "GetUpdateList",
+                                                {"iteration": it})
+                    node_sets.append(set(int(x) for x in rmeta["sources"]))
+                except Exception:
+                    node_sets.append(set())
+            nodes = sorted(set.intersection(*node_sets)) if node_sets else []
+            if not nodes:
+                return self._empty_block()
+            # 2. gather every miner's aggregated slice
+            slices: Dict[int, np.ndarray] = {}
+            ok = True
+            for idx, m in enumerate(miners):
+                if m == self.id:
+                    stack = np.stack([self.round.miner_shares[n] for n in nodes])
+                    slices[idx] = np.asarray(ss.aggregate_shares(stack))
+                    continue
+                try:
+                    _, arrs = await self._call(
+                        m, "GetMinerPart", {"iteration": it, "nodes": nodes})
+                    slices[idx] = np.asarray(arrs["agg_rows"], np.int64)
+                except Exception:
+                    ok = False
+            if not ok or len(slices) != len(miners):
+                return self._empty_block()
+            # 3. reassemble rows and recover the aggregate on device
+            full = np.concatenate([slices[i] for i in range(len(miners))])
+            xs = np.asarray(ss.share_xs(cfg.total_shares))
+            agg = np.asarray(ss.recover_update(
+                full, xs, self.trainer.num_params, cfg.poly_size,
+                cfg.precision))
+            deltas = [Update(source_id=n, iteration=it,
+                             delta=np.zeros(0, np.float64),
+                             commitment=self.round.miner_commitments.get(n, b""),
+                             accepted=True)
+                      for n in nodes]
+            contributors = nodes
+        else:
+            updates = [st.miner_updates[k] for k in sorted(st.miner_updates)]
+            if not updates:
+                return self._empty_block()
+            mat = np.stack([u.delta for u in updates])
+            if cfg.fedsys:
+                agg = mat.mean(axis=0)  # FedSys averages (FedSys/honest.go:311)
+            else:
+                agg = mat.sum(axis=0)  # Biscotti sums (honest.go:360-375)
+            for u in updates:
+                u.accepted = True
+            deltas = updates
+            contributors = [u.source_id for u in updates]
+
+        new_stake = dict(stake)
+        for n in contributors:
+            new_stake[n] = new_stake.get(n, 0) + cfg.stake_unit
+        blk = Block(
+            data=BlockData(iteration=it, global_w=w + agg, deltas=deltas),
+            prev_hash=self.chain.latest_hash(),
+            stake_map=new_stake,
+        ).seal()
+        self._trace("block_minted", contributors=len(contributors))
+        return blk
+
+    def _empty_block(self) -> Block:
+        """Round-advancing empty block (ref: main.go:2099-2143)."""
+        return Block(
+            data=BlockData(iteration=self.iteration,
+                           global_w=self.chain.latest_gradient()),
+            prev_hash=self.chain.latest_hash(),
+            stake_map=self.chain.latest_stake_map(),
+        ).seal()
+
+    # ----------------------------------------------------------- main loop
+
+    async def _run_round(self) -> None:
+        cfg = self.cfg
+        self._compute_roles()
+        it = self.iteration
+        loop = asyncio.get_running_loop()
+        self.round = RoundState(
+            iteration=it,
+            krum_decision=loop.create_future(),
+            block_done=asyncio.Event(),
+        )
+        st = self.round
+        self._trace("round_start",
+                    verifier=self.role_map.is_verifier(self.id),
+                    miner=self.role_map.is_miner(self.id))
+
+        # random self-crash fault injection (ref: main.go:54-55,1117-1120)
+        if cfg.fail_prob > 0 and self._rng.random() < cfg.fail_prob:
+            self._trace("self_crash")
+            os._exit(17)
+
+        work = []
+        if self.role_map.is_verifier(self.id):
+            async def krum_timer():
+                await asyncio.sleep(self.timeouts.krum_s)
+                self._decide_round()  # timeout fallback (ref: krum.go:178-224)
+            work.append(loop.create_task(krum_timer()))
+        if self.role_map.is_miner(self.id):
+            work.append(loop.create_task(self._miner_flow()))
+        if self.role_map.is_vanilla(self.id) or cfg.fedsys:
+            if not (cfg.fedsys and self.id == 0):
+                work.append(loop.create_task(self._worker_flow()))
+        st.tasks.extend(work)
+
+        # block deadline: every peer advances the round no matter what
+        # (ref: main.go:2326-2355 startBlockDeadlineTimer)
+        try:
+            await asyncio.wait_for(st.block_done.wait(),
+                                   self.timeouts.block_s)
+        except asyncio.TimeoutError:
+            if self.iteration == it:
+                self._trace("block_timeout_empty_fallback")
+                self._accept_block(self._empty_block(), gossip=True)
+        if not st.krum_decision.done():
+            st.krum_decision.set_result(set())
+        for t in work:
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(*work, return_exceptions=True)
+
+        # convergence must be a *uniform* decision: every peer evaluates the
+        # same model on the same global test split, so all peers exit at the
+        # same height and the chain-equality oracle holds (the reference
+        # likewise scores the shared global data, ref: honest.go:141-162)
+        err = await asyncio.to_thread(self.trainer.test_error,
+                                      self.chain.latest_gradient())
+        self.logs.append((it, err, time.time()))
+        self._trace("round_end", error=err)
+        if err < cfg.convergence_error:
+            self.converged = True
+
+    async def _announce(self) -> None:
+        """Bootstrap: register with every peer, adopt the longest chain
+        (ref: main.go:926-1024)."""
+        for pid in sorted(self.peers):
+            if pid == self.id:
+                continue
+            try:
+                cmeta, carrays = await self._call(
+                    pid, "RegisterPeer",
+                    {"source_id": self.id, "host": self.peers[self.id][0],
+                     "port": self.peers[self.id][1]})
+                blocks = wire.unpack_chain(cmeta, carrays)
+                if blocks:
+                    other = Blockchain.__new__(Blockchain)
+                    other.blocks = blocks
+                    self.chain.maybe_adopt(other)
+            except Exception:
+                continue
+
+    async def run(self) -> Dict:
+        await self.server.start()
+        if self.id != 0:
+            await self._announce()
+        while not self.converged and self.iteration < self.cfg.max_iterations:
+            await self._run_round()
+        dump = self.chain.dump()
+        await self.server.stop()
+        if self._events:
+            self._events.close()
+        return {
+            "node": self.id,
+            "iterations": self.iteration,
+            "converged": self.converged,
+            "chain_dump": dump,
+            "final_error": self.logs[-1][1] if self.logs else float("nan"),
+            "logs": [f"{i},{e:.6f},{t:.6f}" for i, e, t in self.logs],
+        }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="biscotti-tpu peer agent")
+    BiscottiConfig.add_args(ap)
+    ap.add_argument("--key-dir", default="")
+    ap.add_argument("--log-dir", default="")
+    ns = ap.parse_args(argv)
+    cfg = BiscottiConfig.from_args(ns)
+    cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
+        cfg.num_nodes, cfg.num_verifiers, cfg.num_miners))
+    log_path = (os.path.join(ns.log_dir, f"events_{cfg.node_id}.jsonl")
+                if ns.log_dir else "")
+    agent = PeerAgent(cfg, key_dir=ns.key_dir, log_path=log_path)
+    result = asyncio.run(agent.run())
+    print("=== CHAIN DUMP ===")
+    print(result["chain_dump"])
+    print("=== LOGS ===")
+    for line in result["logs"]:
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,149 @@
+"""Asyncio RPC layer: per-call dial, per-call timeout, typed errors.
+
+Mirrors the reference's transport semantics (SURVEY.md §2.1 row 2, §5.8):
+  * one TCP dial per call with a `select{reply, timeout}` guard
+    (ref: DistSys/main.go:1447-1489) — `call()` wraps the dial+roundtrip in
+    `asyncio.wait_for`
+  * the callee can reply with a *stale* error that callers treat as a
+    signal, not a failure (ref: DistSys/main.go:140,380-383 staleError)
+  * dead peers surface as TimeoutError/ConnectionError so the membership
+    layer can evict them (ref: main.go:1468-1487)
+
+Server side: one asyncio task per connection, frames dispatched to a single
+handler coroutine `handle(msg_type, meta, arrays) -> (meta, arrays)`.
+Handlers may block (e.g. a verifier parking a caller until the round's Krum
+resolves, ref: DistSys/krum.go:330-336) — each request runs as its own task
+so a parked call never stalls the connection's other requests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from biscotti_tpu.runtime import messages as msgs
+
+Handler = Callable[
+    [str, Dict[str, Any], Dict[str, np.ndarray]],
+    Awaitable[Tuple[Dict[str, Any], Dict[str, np.ndarray]]],
+]
+
+
+class RPCError(RuntimeError):
+    """Remote handler returned an error (meta carries the reason)."""
+
+    def __init__(self, reason: str, stale: bool = False):
+        super().__init__(reason)
+        self.reason = reason
+        self.stale = stale
+
+
+class StaleError(RPCError):
+    """The callee is past this message's iteration (ref: main.go:380-383)."""
+
+    def __init__(self, reason: str = "stale iteration"):
+        super().__init__(reason, stale=True)
+
+
+class RPCServer:
+    def __init__(self, host: str, port: int, handler: Handler):
+        self.host = host
+        self.port = port
+        self.handler = handler
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conn_tasks: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._on_conn, self.host,
+                                                  self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        write_lock = asyncio.Lock()
+        pending: set = set()
+        try:
+            while True:
+                try:
+                    payload = await msgs.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                try:
+                    msg_type, meta, arrays = msgs.decode(payload)
+                except msgs.CodecError:
+                    break  # hostile/garbled peer: drop the connection
+                t = asyncio.create_task(
+                    self._dispatch(msg_type, meta, arrays, writer, write_lock)
+                )
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+        finally:
+            for t in pending:
+                t.cancel()
+            writer.close()
+            self._conn_tasks.discard(task)
+
+    async def _dispatch(self, msg_type, meta, arrays, writer, write_lock):
+        rid = meta.get("rid")
+        try:
+            rmeta, rarrays = await self.handler(msg_type, meta, arrays)
+        except StaleError as e:
+            rmeta, rarrays = {"error": e.reason, "stale": True}, {}
+        except RPCError as e:
+            rmeta, rarrays = {"error": e.reason}, {}
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # handler bug: report, don't kill the peer
+            rmeta, rarrays = {"error": f"internal: {type(e).__name__}: {e}"}, {}
+        rmeta = dict(rmeta)
+        rmeta["rid"] = rid
+        frame = msgs.encode(msg_type + ".reply", rmeta, rarrays)
+        async with write_lock:
+            try:
+                writer.write(frame)
+                await writer.drain()
+            except ConnectionError:
+                pass
+
+
+async def call(host: str, port: int, msg_type: str,
+               meta: Dict[str, Any] | None = None,
+               arrays: Dict[str, np.ndarray] | None = None,
+               timeout: float = 120.0) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    """Dial, send one request, await the reply, close. Raises
+    asyncio.TimeoutError / ConnectionError on dead peers, StaleError /
+    RPCError on remote-signalled failures."""
+
+    async def _roundtrip():
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            meta2 = dict(meta or {})
+            meta2["rid"] = 0
+            writer.write(msgs.encode(msg_type, meta2, arrays))
+            await writer.drain()
+            payload = await msgs.read_frame(reader)
+            _, rmeta, rarrays = msgs.decode(payload)
+            return rmeta, rarrays
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    rmeta, rarrays = await asyncio.wait_for(_roundtrip(), timeout)
+    if rmeta.get("error"):
+        if rmeta.get("stale"):
+            raise StaleError(rmeta["error"])
+        raise RPCError(rmeta["error"])
+    return rmeta, rarrays

@@ -1,0 +1,104 @@
+"""Block/Update <-> wire conversion for the runtime codec.
+
+The reference gob-encodes its structs directly (ref: DistSys/main.go:609-610);
+our codec separates JSON metadata from raw array payloads, so blocks and
+updates need explicit packers. All byte fields travel as hex in metadata.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from biscotti_tpu.ledger.block import Block, BlockData, Update
+
+
+def pack_update(u: Update, prefix: str = "u") -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    meta = {
+        "source_id": u.source_id,
+        "iteration": u.iteration,
+        "commitment": u.commitment.hex(),
+        "accepted": u.accepted,
+        "signatures": [s.hex() for s in u.signatures],
+        "has_noise": u.noise is not None,
+        "has_noised": u.noised_delta is not None,
+    }
+    arrays = {f"{prefix}.delta": u.delta}
+    if u.noise is not None:
+        arrays[f"{prefix}.noise"] = u.noise
+    if u.noised_delta is not None:
+        arrays[f"{prefix}.noised"] = u.noised_delta
+    return meta, arrays
+
+
+def unpack_update(meta: Dict[str, Any], arrays: Dict[str, np.ndarray],
+                  prefix: str = "u") -> Update:
+    return Update(
+        source_id=int(meta["source_id"]),
+        iteration=int(meta["iteration"]),
+        delta=np.asarray(arrays[f"{prefix}.delta"], dtype=np.float64),
+        commitment=bytes.fromhex(meta.get("commitment", "")),
+        noise=np.asarray(arrays[f"{prefix}.noise"], np.float64)
+        if meta.get("has_noise") else None,
+        noised_delta=np.asarray(arrays[f"{prefix}.noised"], np.float64)
+        if meta.get("has_noised") else None,
+        accepted=bool(meta.get("accepted", False)),
+        signatures=[bytes.fromhex(s) for s in meta.get("signatures", [])],
+    )
+
+
+def pack_block(blk: Block) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    metas: List[Dict[str, Any]] = []
+    arrays: Dict[str, np.ndarray] = {"global_w": blk.data.global_w}
+    for i, u in enumerate(blk.data.deltas):
+        m, a = pack_update(u, prefix=f"d{i}")
+        metas.append(m)
+        arrays.update(a)
+    meta = {
+        "iteration": blk.data.iteration,
+        "prev_hash": blk.prev_hash.hex(),
+        "hash": blk.hash.hex(),
+        "timestamp": blk.timestamp,
+        "stake_map": {str(k): v for k, v in blk.stake_map.items()},
+        "deltas": metas,
+    }
+    return meta, arrays
+
+
+def unpack_block(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> Block:
+    deltas = [
+        unpack_update(m, arrays, prefix=f"d{i}")
+        for i, m in enumerate(meta.get("deltas", []))
+    ]
+    blk = Block(
+        data=BlockData(
+            iteration=int(meta["iteration"]),
+            global_w=np.asarray(arrays["global_w"], dtype=np.float64),
+            deltas=deltas,
+        ),
+        prev_hash=bytes.fromhex(meta["prev_hash"]),
+        stake_map={int(k): int(v) for k, v in meta.get("stake_map", {}).items()},
+        timestamp=int(meta.get("timestamp", 0)),
+    )
+    blk.hash = bytes.fromhex(meta.get("hash", ""))
+    return blk
+
+
+def pack_chain(blocks: List[Block]) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    metas = []
+    arrays: Dict[str, np.ndarray] = {}
+    for i, blk in enumerate(blocks):
+        m, a = pack_block(blk)
+        metas.append(m)
+        arrays.update({f"b{i}.{k}": v for k, v in a.items()})
+    return {"blocks": metas}, arrays
+
+
+def unpack_chain(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> List[Block]:
+    out = []
+    for i, m in enumerate(meta.get("blocks", [])):
+        sub = {k[len(f"b{i}."):]: v for k, v in arrays.items()
+               if k.startswith(f"b{i}.")}
+        out.append(unpack_block(m, sub))
+    return out

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Local integration harness — the reference's localTest.sh as a Python
+driver (ref: DistSys/localTest.sh:24-96).
+
+Boots N real peer processes on localhost ports, waits for all to exit
+(converged or max-iterations), then compares every pair of chain dumps
+byte-for-byte: any divergence fails the run. This is the top-level
+consistency oracle of the whole system.
+
+Usage: python eval/local_test.py --nodes 5 --dataset creditcard \
+           [--max-iterations 3] [--fedsys] [--kill-node 2 --kill-after 5]
+
+--kill-node/--kill-after add the fault-injection variant (kill a random
+peer mid-run, expect the rest to keep minting blocks; ref:
+DistSys/failAndRestartLocal.sh, localTest.sh:100-250).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def extract_chain(stdout: str) -> str:
+    lines = stdout.splitlines()
+    try:
+        a = lines.index("=== CHAIN DUMP ===")
+        b = lines.index("=== LOGS ===")
+    except ValueError:
+        return ""
+    return "\n".join(lines[a + 1 : b])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=5)
+    ap.add_argument("--dataset", default="creditcard")
+    ap.add_argument("--base-port", type=int, default=23000)
+    ap.add_argument("--max-iterations", type=int, default=3)
+    ap.add_argument("--fedsys", action="store_true")
+    ap.add_argument("--secure-agg", type=int, default=0)
+    ap.add_argument("--noising", type=int, default=0)
+    ap.add_argument("--verification", type=int, default=0)
+    ap.add_argument("--num-verifiers", type=int, default=1)
+    ap.add_argument("--num-miners", type=int, default=1)
+    ap.add_argument("--kill-node", type=int, default=-1)
+    ap.add_argument("--kill-after", type=float, default=5.0)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args(argv)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = []
+    for i in range(args.nodes):
+        cmd = [
+            sys.executable, "-m", "biscotti_tpu.runtime.peer",
+            "-i", str(i), "-t", str(args.nodes), "-d", args.dataset,
+            "-p", str(args.base_port),
+            "-na", str(args.num_miners), "-nv", str(args.num_verifiers),
+            "-sa", str(args.secure_agg), "-np", str(args.noising),
+            "-vp", str(args.verification),
+            "--max-iterations", str(args.max_iterations),
+            "--fedsys", "1" if args.fedsys else "0",
+        ]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.PIPE, text=True,
+                                      env=env, cwd=REPO))
+        time.sleep(0.1)  # node 0 listens first (ref: localTest.sh boot order)
+
+    if args.kill_node >= 0:
+        time.sleep(args.kill_after)
+        print(f"[harness] killing node {args.kill_node}", file=sys.stderr)
+        procs[args.kill_node].send_signal(signal.SIGKILL)
+
+    deadline = time.time() + args.timeout
+    outs = []
+    for i, p in enumerate(procs):
+        remain = max(1.0, deadline - time.time())
+        try:
+            out, err = p.communicate(timeout=remain)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            print(f"[harness] node {i} TIMED OUT; stderr tail:\n"
+                  + "\n".join(err.splitlines()[-5:]), file=sys.stderr)
+        outs.append(out)
+
+    chains = [extract_chain(o) for o in outs]
+    survivors = [i for i in range(args.nodes) if i != args.kill_node]
+    ok = True
+    ref_chain = chains[survivors[0]]
+    if not ref_chain:
+        print("[harness] node 0 produced no chain dump", file=sys.stderr)
+        ok = False
+    for i in survivors[1:]:
+        if chains[i] != ref_chain:
+            print(f"[harness] CHAIN MISMATCH node {i} vs node {survivors[0]}:",
+                  file=sys.stderr)
+            print(f"--- node {survivors[0]} ---\n{ref_chain}", file=sys.stderr)
+            print(f"--- node {i} ---\n{chains[i]}", file=sys.stderr)
+            ok = False
+    n_blocks = len(ref_chain.splitlines()) if ref_chain else 0
+    print(f"[harness] {'PASS' if ok else 'FAIL'}: "
+          f"{len(survivors)} peers, {n_blocks} blocks, chains "
+          f"{'identical' if ok else 'DIVERGED'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
