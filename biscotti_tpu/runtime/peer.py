@@ -73,8 +73,10 @@ class RoundState:
 
 class PeerAgent:
     def __init__(self, cfg: BiscottiConfig, key_dir: str = "",
-                 log_path: str = ""):
+                 log_path: str = "", ckpt_dir: str = "", ckpt_every: int = 10):
         self.cfg = cfg
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_every = max(1, ckpt_every)
         self.id = cfg.node_id
         self.converged = False
         self.total_updates = 0
@@ -742,11 +744,30 @@ class PeerAgent:
                 continue
 
     async def run(self) -> Dict:
+        # resume from the newest on-disk snapshot, then let longest-chain
+        # adoption advance us further (SURVEY §5.4: the chain IS the
+        # checkpoint; the snapshot only survives full-network restarts)
+        if self.ckpt_dir:
+            from biscotti_tpu.utils import checkpoint as ckpt
+
+            try:
+                restored = ckpt.load(self.ckpt_dir)
+                if len(restored.blocks) > len(self.chain.blocks):
+                    self.chain = restored
+                    self._trace("checkpoint_restored",
+                                height=self.chain.latest.iteration)
+            except FileNotFoundError:
+                pass
         await self.server.start()
         if self.id != 0:
             await self._announce()
         while not self.converged and self.iteration < self.cfg.max_iterations:
             await self._run_round()
+            if self.ckpt_dir and self.iteration % self.ckpt_every == 0:
+                from biscotti_tpu.utils import checkpoint as ckpt
+
+                await asyncio.to_thread(ckpt.save, self.chain, self.ckpt_dir)
+                await asyncio.to_thread(ckpt.prune, self.ckpt_dir, 3)
         dump = self.chain.dump()
         await self.server.stop()
         if self._events:
@@ -768,13 +789,18 @@ def main(argv=None) -> int:
     BiscottiConfig.add_args(ap)
     ap.add_argument("--key-dir", default="")
     ap.add_argument("--log-dir", default="")
+    ap.add_argument("--ckpt-dir", default="")
+    ap.add_argument("--ckpt-every", type=int, default=10)
     ns = ap.parse_args(argv)
     cfg = BiscottiConfig.from_args(ns)
     cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
         cfg.num_nodes, cfg.num_verifiers, cfg.num_miners))
     log_path = (os.path.join(ns.log_dir, f"events_{cfg.node_id}.jsonl")
                 if ns.log_dir else "")
-    agent = PeerAgent(cfg, key_dir=ns.key_dir, log_path=log_path)
+    ckpt_dir = (os.path.join(ns.ckpt_dir, f"node_{cfg.node_id}")
+                if ns.ckpt_dir else "")
+    agent = PeerAgent(cfg, key_dir=ns.key_dir, log_path=log_path,
+                      ckpt_dir=ckpt_dir, ckpt_every=ns.ckpt_every)
     result = asyncio.run(agent.run())
     print("=== CHAIN DUMP ===")
     print(result["chain_dump"])

@@ -1,0 +1,147 @@
+"""Chain + model checkpointing.
+
+The reference's only checkpoint is the blockchain itself — every block
+carries the full model, resume = fetch the chain from any peer
+(ref: SURVEY.md §5.4; DistSys/blockData.go:10-14, main.go:431-433,
+blockchain.go:31-37). It keeps nothing on disk, so a full-network restart
+loses all progress.
+
+This module adds what the reference lacks: periodic on-disk snapshots of the
+whole chain (and therefore the model), so a cold-started network resumes
+from the last sealed height instead of genesis. Format is
+orbax-checkpoint-compatible in spirit (a directory per step, atomic rename
+commit) but self-contained: one .npz per block plus a JSON manifest — no
+dependency on orbax's async machinery for host-side control-plane state.
+Snapshots are verified on load (`Blockchain.verify`) so a tampered or
+torn checkpoint is refused, never adopted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from biscotti_tpu.ledger.block import Block, BlockData, Update
+from biscotti_tpu.ledger.chain import Blockchain, ChainInvariantError
+
+
+def _block_to_npz_dict(blk: Block, idx: int) -> Dict[str, np.ndarray]:
+    out = {f"b{idx}.global_w": blk.data.global_w}
+    for j, u in enumerate(blk.data.deltas):
+        out[f"b{idx}.d{j}.delta"] = u.delta
+    return out
+
+
+def _block_meta(blk: Block) -> Dict:
+    return {
+        "iteration": blk.data.iteration,
+        "prev_hash": blk.prev_hash.hex(),
+        "hash": blk.hash.hex(),
+        "timestamp": blk.timestamp,
+        "stake_map": {str(k): v for k, v in blk.stake_map.items()},
+        "deltas": [
+            {
+                "source_id": u.source_id,
+                "iteration": u.iteration,
+                "commitment": u.commitment.hex(),
+                "accepted": u.accepted,
+                "signatures": [s.hex() for s in u.signatures],
+            }
+            for u in blk.data.deltas
+        ],
+    }
+
+
+def save(chain: Blockchain, directory: str, step: Optional[int] = None) -> str:
+    """Atomically write a snapshot of the full chain; returns the snapshot
+    path. Layout: <dir>/step_<height>/{manifest.json, blocks.npz}."""
+    step = chain.latest.iteration if step is None else step
+    final = os.path.join(directory, f"step_{step}")
+    os.makedirs(directory, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=directory)
+    try:
+        arrays: Dict[str, np.ndarray] = {}
+        metas: List[Dict] = []
+        for i, blk in enumerate(chain.blocks):
+            arrays.update(_block_to_npz_dict(blk, i))
+            metas.append(_block_meta(blk))
+        np.savez_compressed(os.path.join(tmp, "blocks.npz"), **arrays)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump({"version": 1, "num_blocks": len(chain.blocks),
+                       "blocks": metas}, f)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)  # atomic commit (same filesystem)
+        return final
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def list_steps(directory: str) -> List[int]:
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        if name.startswith("step_"):
+            try:
+                out.append(int(name[5:]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def load(directory: str, step: Optional[int] = None) -> Blockchain:
+    """Load and VERIFY a snapshot; raises ChainInvariantError on tampering,
+    FileNotFoundError when no snapshot exists."""
+    steps = list_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+    step = steps[-1] if step is None else step
+    path = os.path.join(directory, f"step_{step}")
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    arrays = np.load(os.path.join(path, "blocks.npz"))
+    blocks: List[Block] = []
+    for i, meta in enumerate(manifest["blocks"]):
+        deltas = []
+        for j, d in enumerate(meta["deltas"]):
+            key = f"b{i}.d{j}.delta"
+            deltas.append(Update(
+                source_id=int(d["source_id"]),
+                iteration=int(d["iteration"]),
+                delta=np.asarray(arrays[key], np.float64)
+                if key in arrays else np.zeros(0, np.float64),
+                commitment=bytes.fromhex(d.get("commitment", "")),
+                accepted=bool(d.get("accepted", False)),
+                signatures=[bytes.fromhex(s) for s in d.get("signatures", [])],
+            ))
+        blk = Block(
+            data=BlockData(iteration=int(meta["iteration"]),
+                           global_w=np.asarray(arrays[f"b{i}.global_w"],
+                                               np.float64),
+                           deltas=deltas),
+            prev_hash=bytes.fromhex(meta["prev_hash"]),
+            stake_map={int(k): int(v)
+                       for k, v in meta.get("stake_map", {}).items()},
+            timestamp=int(meta.get("timestamp", 0)),
+        )
+        blk.hash = bytes.fromhex(meta["hash"])
+        blocks.append(blk)
+    chain = Blockchain.__new__(Blockchain)
+    chain.blocks = blocks
+    chain.verify()  # refuse tampered/torn snapshots
+    return chain
+
+
+def prune(directory: str, keep: int = 3) -> None:
+    """Drop all but the newest `keep` snapshots."""
+    steps = list_steps(directory)
+    for s in steps[:-keep] if keep > 0 else steps:
+        shutil.rmtree(os.path.join(directory, f"step_{s}"),
+                      ignore_errors=True)
