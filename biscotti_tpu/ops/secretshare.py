@@ -43,6 +43,16 @@ POLY_SIZE = 10  # ref: main.go:46
 SHARE_OFFSET = 10  # ref: kyber.go:589
 
 
+def _require_x64(what: str) -> None:
+    """Fail loudly instead of silently wrapping in int32: without x64 mode
+    jnp int64 arrays degrade to int32 and share values (~10¹³) overflow."""
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            f"{what} requires JAX x64 mode: call "
+            "jax.config.update('jax_enable_x64', True) (or set "
+            "JAX_ENABLE_X64=1) before any share math")
+
+
 def total_shares_for(num_miners: int, poly_size: int = POLY_SIZE) -> int:
     """TOTAL_SHARES = ceil(2·POLY_SIZE/NUM_MINERS)·NUM_MINERS
     (ref: main.go:825)."""
@@ -94,6 +104,9 @@ def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
                 total_shares: int = 2 * POLY_SIZE) -> jax.Array:
     """[d] quantized update → [S, C] share matrix: share s of chunk c is the
     exact integer evaluation of chunk-polynomial c at x_s."""
+    _require_x64("make_shares")
+    if q.dtype != jnp.int64:
+        raise TypeError(f"make_shares wants int64 quantized input, got {q.dtype}")
     coeffs = to_chunks(q, poly_size)  # [C, k]
     v = vandermonde(share_xs(total_shares), poly_size)  # [S, k]
     return v @ coeffs.T  # [S, C]
@@ -118,6 +131,7 @@ def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
     """[S, C] aggregated shares (+ their x points) → [C, k] int64 chunk
     coefficients via float64 least-squares, rounded (ref: kyber.go:809-867 —
     the reference also recovers approximately, via mat64 QR)."""
+    _require_x64("recover_coeffs")
     v = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
     sol, _, _, _ = jnp.linalg.lstsq(v, agg_shares.astype(jnp.float64))
     return jnp.round(sol.T).astype(jnp.int64)  # [C, k]

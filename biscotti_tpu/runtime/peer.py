@@ -750,14 +750,29 @@ class PeerAgent:
         if self.ckpt_dir:
             from biscotti_tpu.utils import checkpoint as ckpt
 
-            try:
-                restored = ckpt.load(self.ckpt_dir)
-                if len(restored.blocks) > len(self.chain.blocks):
-                    self.chain = restored
+            # newest snapshot first, older ones as fallback: a torn newest
+            # write must not discard an intact older snapshot. Any corrupt
+            # snapshot (bad zip, bad json, structurally wrong manifest,
+            # failed chain verify) is skipped, never a startup crash —
+            # worst case we start from genesis and longest-chain adoption
+            # catches us up from live peers.
+            for step in reversed(ckpt.list_steps(self.ckpt_dir)):
+                try:
+                    restored = ckpt.load(self.ckpt_dir, step=step)
+                except Exception as e:
+                    self._trace("checkpoint_rejected", step=step,
+                                error=f"{type(e).__name__}: {e}")
+                    continue
+                # same guards as live-network adoption: longer, verified,
+                # grown from OUR genesis — a stale/foreign ckpt-dir
+                # (different dims / num_nodes / stake) hashes to a
+                # different genesis and is refused, as is an empty chain
+                if self.chain.maybe_adopt(restored):
                     self._trace("checkpoint_restored",
                                 height=self.chain.latest.iteration)
-            except FileNotFoundError:
-                pass
+                    break
+                self._trace("checkpoint_rejected", step=step,
+                            error="not adoptable")
         await self.server.start()
         if self.id != 0:
             await self._announce()
@@ -792,6 +807,12 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--ckpt-every", type=int, default=10)
     ns = ap.parse_args(argv)
+    # share math (ops/secretshare.py) silently wraps in int32 without x64;
+    # enable it at the process entrypoint, before any jax use (in-process
+    # embedders must do this themselves — secretshare fails loudly if not)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
     cfg = BiscottiConfig.from_args(ns)
     cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
         cfg.num_nodes, cfg.num_verifiers, cfg.num_miners))

@@ -31,9 +31,17 @@ from biscotti_tpu.ledger.chain import Blockchain, ChainInvariantError
 
 
 def _block_to_npz_dict(blk: Block, idx: int) -> Dict[str, np.ndarray]:
+    # Every field covered by Block.compute_hash must round-trip, or the
+    # reloaded chain fails its own hash verification: noise/noised_delta are
+    # hashed via Update.canonical_bytes, so they are persisted too (None is
+    # encoded by key absence).
     out = {f"b{idx}.global_w": blk.data.global_w}
     for j, u in enumerate(blk.data.deltas):
         out[f"b{idx}.d{j}.delta"] = u.delta
+        if u.noise is not None:
+            out[f"b{idx}.d{j}.noise"] = u.noise
+        if u.noised_delta is not None:
+            out[f"b{idx}.d{j}.noised_delta"] = u.noised_delta
     return out
 
 
@@ -112,12 +120,18 @@ def load(directory: str, step: Optional[int] = None) -> Blockchain:
         deltas = []
         for j, d in enumerate(meta["deltas"]):
             key = f"b{i}.d{j}.delta"
+            nkey = f"b{i}.d{j}.noise"
+            ndkey = f"b{i}.d{j}.noised_delta"
             deltas.append(Update(
                 source_id=int(d["source_id"]),
                 iteration=int(d["iteration"]),
                 delta=np.asarray(arrays[key], np.float64)
                 if key in arrays else np.zeros(0, np.float64),
                 commitment=bytes.fromhex(d.get("commitment", "")),
+                noise=np.asarray(arrays[nkey], np.float64)
+                if nkey in arrays else None,
+                noised_delta=np.asarray(arrays[ndkey], np.float64)
+                if ndkey in arrays else None,
                 accepted=bool(d.get("accepted", False)),
                 signatures=[bytes.fromhex(s) for s in d.get("signatures", [])],
             ))
