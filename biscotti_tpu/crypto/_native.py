@@ -23,11 +23,52 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 
 
+def _build() -> None:
+    """Build the shared object from source if absent (the .so is not
+    committed: its provenance could not be audited against the source).
+    Disable with BISCOTTI_NO_NATIVE_BUILD=1."""
+    if os.environ.get("BISCOTTI_NO_NATIVE_BUILD"):
+        return
+    import subprocess
+
+    native_dir = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=True, timeout=120)
+    except Exception:
+        pass  # pure-Python fallback covers everything
+
+
+def _selfcheck(lib: ctypes.CDLL) -> bool:
+    """Cross-check the loaded binary against the pure-Python backend on a
+    small random instance; a stale or tampered .so is refused, silently
+    falling back to Python."""
+    import secrets
+
+    scalars = [int.from_bytes(secrets.token_bytes(16), "little") + 1
+               for _ in range(4)]
+    points = [ed.scalar_mult(i + 2, ed.BASE) for i in range(4)]
+    expect = ed.IDENTITY
+    for s, p in zip(scalars, points):
+        expect = ed.point_add(expect, ed.scalar_mult(s % ed.Q, p))
+    sbuf = b"".join((s % ed.Q).to_bytes(32, "little") for s in scalars)
+    pbuf = b"".join(_point_bytes(p) for p in points)
+    out = ctypes.create_string_buffer(64)
+    if lib.ed25519_msm(sbuf, pbuf, 4, out) != 0:
+        return False
+    x = int.from_bytes(out.raw[:32], "little")
+    y = int.from_bytes(out.raw[32:], "little")
+    return ed.point_equal((x, y, 1, (x * y) % ed.P), expect)
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
     if _load_attempted:
         return _lib
     _load_attempted = True
+    if not any(os.path.exists(os.path.abspath(p)) for p in _LIB_PATHS):
+        _build()
     for path in _LIB_PATHS:
         full = os.path.abspath(path)
         if os.path.exists(full):
@@ -38,9 +79,16 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
                     ctypes.c_char_p,
                 ]
+                lib.ed25519_batch_commit.restype = ctypes.c_int
+                lib.ed25519_batch_commit.argtypes = [
+                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+                    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                ]
+                if not _selfcheck(lib):
+                    continue
                 _lib = lib
                 break
-            except OSError:
+            except (OSError, AttributeError):
                 continue
     return _lib
 
@@ -87,3 +135,30 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     x = int.from_bytes(out.raw[:32], "little")
     y = int.from_bytes(out.raw[32:], "little")
     return (x, y, 1, (x * y) % ed.P)
+
+
+def batch_commit(a: Sequence[int], b: Sequence[int]) -> List[bytes]:
+    """[aᵢ·G + bᵢ·H] compressed — worker-side VSS coefficient commitments
+    (byte-comb fixed-base path in C++)."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(a) != len(b):
+        raise ValueError("scalar length mismatch")
+    n = len(a)
+    if n == 0:
+        return []
+    abuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in a)
+    bbuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in b)
+    from biscotti_tpu.crypto.commitments import H_POINT
+
+    out = ctypes.create_string_buffer(64 * n)
+    rc = lib.ed25519_batch_commit(abuf, bbuf, _point_bytes(ed.BASE),
+                                  _point_bytes(H_POINT), n, out)
+    if rc != 0:
+        raise RuntimeError(f"native batch_commit failed: {rc}")
+    res: List[bytes] = []
+    for i in range(n):
+        x = int.from_bytes(out.raw[64 * i: 64 * i + 32], "little")
+        y = int.from_bytes(out.raw[64 * i + 32: 64 * i + 64], "little")
+        res.append(((y | ((x & 1) << 255)).to_bytes(32, "little")))
+    return res
