@@ -47,6 +47,11 @@ class Update:
     noised_delta: Optional[np.ndarray] = None  # delta + noise, sent to verifiers
     accepted: bool = False
     signatures: List[bytes] = field(default_factory=list)  # verifier Schnorr sigs
+    # which verifier produced each signature — receivers verify each sig
+    # against the claimed signer's public key (the reference ships bare
+    # signature lists, update.go:21, and its miner-side check was disabled;
+    # here the quorum check is enforced, so the binding must travel)
+    signers: List[int] = field(default_factory=list)
 
     def canonical_bytes(self) -> bytes:
         out = [struct.pack("<qq?", self.source_id, self.iteration, self.accepted)]
@@ -56,6 +61,8 @@ class Update:
         out.append(_pack_f64(self.noised_delta))
         out.append(struct.pack("<q", len(self.signatures)))
         out.extend(_pack_bytes(s) for s in self.signatures)
+        out.append(struct.pack("<q", len(self.signers)))
+        out.extend(struct.pack("<q", s) for s in self.signers)
         return b"".join(out)
 
 

@@ -28,6 +28,17 @@ Semantics kept from the reference:
 
 Requires x64 mode (`jax.config.update("jax_enable_x64", True)`) — share
 values reach ~10¹³ for degree-9 chunks at PRECISION=4.
+
+Device placement: the share pipeline is **pinned to the host CPU backend**.
+TPUs have no native int64 datapath — XLA's x64 rewriter cannot split an
+`s64 dot_general` (observed: `jit(make_shares)` fails AOT compilation on
+v5e with "X64 rewriting not implemented" for the share matmul), and the
+values here genuinely need 64 exact integer bits. This is a deliberate
+design decision, not a fallback-by-accident: share algebra is control-plane
+crypto that rides next to the (host-side) EC commitments, its cost is
+O(S·d) integer ops — trivial against the O(d) curve MSM on the same path —
+and pinning it to the always-present CPU backend keeps the TPU program
+free of emulated-int64 stalls. The float ML path never touches this module.
 """
 
 from __future__ import annotations
@@ -41,6 +52,11 @@ import jax.numpy as jnp
 PRECISION = 4  # ref: main.go:45
 POLY_SIZE = 10  # ref: main.go:46
 SHARE_OFFSET = 10  # ref: kyber.go:589
+
+
+def _cpu_device():
+    """The host CPU device — present under every JAX backend."""
+    return jax.local_devices(backend="cpu")[0]
 
 
 def _require_x64(what: str) -> None:
@@ -100,16 +116,25 @@ def vandermonde(xs: jax.Array, poly_size: int = POLY_SIZE) -> jax.Array:
 
 
 @partial(jax.jit, static_argnames=("poly_size", "total_shares"))
-def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
-                total_shares: int = 2 * POLY_SIZE) -> jax.Array:
-    """[d] quantized update → [S, C] share matrix: share s of chunk c is the
-    exact integer evaluation of chunk-polynomial c at x_s."""
-    _require_x64("make_shares")
-    if q.dtype != jnp.int64:
-        raise TypeError(f"make_shares wants int64 quantized input, got {q.dtype}")
+def _make_shares_jit(q: jax.Array, poly_size: int,
+                     total_shares: int) -> jax.Array:
     coeffs = to_chunks(q, poly_size)  # [C, k]
     v = vandermonde(share_xs(total_shares), poly_size)  # [S, k]
     return v @ coeffs.T  # [S, C]
+
+
+def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
+                total_shares: int = 2 * POLY_SIZE) -> jax.Array:
+    """[d] quantized update → [S, C] share matrix: share s of chunk c is the
+    exact integer evaluation of chunk-polynomial c at x_s. Runs on the host
+    CPU backend (see module docstring: TPUs have no exact-int64 matmul)."""
+    _require_x64("make_shares")
+    q = jnp.asarray(q)
+    if q.dtype != jnp.int64:
+        raise TypeError(f"make_shares wants int64 quantized input, got {q.dtype}")
+    with jax.default_device(_cpu_device()):
+        return _make_shares_jit(jax.device_put(q, _cpu_device()),
+                                poly_size, total_shares)
 
 
 def miner_rows(total_shares: int, miner_idx: int, num_miners: int) -> slice:
@@ -119,22 +144,39 @@ def miner_rows(total_shares: int, miner_idx: int, num_miners: int) -> slice:
 
 
 @jax.jit
-def aggregate_shares(peer_shares: jax.Array) -> jax.Array:
-    """Homomorphic aggregation: [P, S, C] → [S, C]. Works identically on a
-    miner's slice [P, S/M, C] (ref: kyber.go:244-287 aggregateSecret)."""
+def _aggregate_shares_jit(peer_shares: jax.Array) -> jax.Array:
     return jnp.sum(peer_shares, axis=0)
 
 
+def aggregate_shares(peer_shares: jax.Array) -> jax.Array:
+    """Homomorphic aggregation: [P, S, C] → [S, C]. Works identically on a
+    miner's slice [P, S/M, C] (ref: kyber.go:244-287 aggregateSecret).
+    CPU-pinned with the rest of the int64 share pipeline."""
+    with jax.default_device(_cpu_device()):
+        return _aggregate_shares_jit(
+            jax.device_put(jnp.asarray(peer_shares), _cpu_device()))
+
+
 @partial(jax.jit, static_argnames=("poly_size",))
+def _recover_coeffs_jit(agg_shares: jax.Array, xs: jax.Array,
+                        poly_size: int) -> jax.Array:
+    v = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
+    sol, _, _, _ = jnp.linalg.lstsq(v, agg_shares.astype(jnp.float64))
+    return jnp.round(sol.T).astype(jnp.int64)  # [C, k]
+
+
 def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
                    poly_size: int = POLY_SIZE) -> jax.Array:
     """[S, C] aggregated shares (+ their x points) → [C, k] int64 chunk
     coefficients via float64 least-squares, rounded (ref: kyber.go:809-867 —
-    the reference also recovers approximately, via mat64 QR)."""
+    the reference also recovers approximately, via mat64 QR). CPU-pinned
+    with the rest of the int64 share pipeline."""
     _require_x64("recover_coeffs")
-    v = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
-    sol, _, _, _ = jnp.linalg.lstsq(v, agg_shares.astype(jnp.float64))
-    return jnp.round(sol.T).astype(jnp.int64)  # [C, k]
+    cpu = _cpu_device()
+    with jax.default_device(cpu):
+        return _recover_coeffs_jit(jax.device_put(jnp.asarray(agg_shares), cpu),
+                                   jax.device_put(jnp.asarray(xs), cpu),
+                                   poly_size)
 
 
 def recover_update(agg_shares: jax.Array, xs: jax.Array, num_params: int,

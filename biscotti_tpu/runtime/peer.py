@@ -54,6 +54,28 @@ from biscotti_tpu.runtime.rpc import RPCError, StaleError
 from biscotti_tpu.tools import keygen
 
 
+# keyless-mode derived keypairs, cached module-wide: in-process clusters
+# construct N agents that each need all N publics — deriving them N² times
+# (a base mult each) would dominate small-test startup
+_keyless_pub_cache: Dict[Tuple[int, int], Tuple[bytes, bytes]] = {}
+
+
+def _keyless_pubs(seed: int, node: int) -> Tuple[bytes, bytes]:
+    """(schnorr_pub, vrf_noise_pub) for a keyless-mode node. The seeds are
+    deterministic in (cfg.seed, id), so every peer can derive every public —
+    no integrity in a hostile deployment (pass --key-dir for that), but the
+    full verification code path runs in local tests."""
+    key = (seed, node)
+    if key not in _keyless_pub_cache:
+        from biscotti_tpu.crypto import ed25519 as ed
+
+        s_seed = hashlib.sha256(f"schnorr-{seed}-{node}".encode()).digest()
+        n_seed = hashlib.sha256(f"vrf-noise-{seed}-{node}".encode()).digest()
+        _keyless_pub_cache[key] = (ed.public_key(s_seed),
+                                   VRFKey(n_seed).public)
+    return _keyless_pub_cache[key]
+
+
 @dataclass
 class RoundState:
     """Everything scoped to one iteration; rebuilt on every round
@@ -67,6 +89,10 @@ class RoundState:
     miner_updates: Dict[int, Update] = field(default_factory=dict)
     miner_shares: Dict[int, np.ndarray] = field(default_factory=dict)
     miner_commitments: Dict[int, bytes] = field(default_factory=dict)
+    # sources whose submission failed cryptographic verification this round:
+    # carried into the minted block as accepted=False records and debited
+    # STAKE_UNIT (ref: honest.go:363-370 debits rejected block updates)
+    miner_rejected: Dict[int, Update] = field(default_factory=dict)
     block_done: Optional[asyncio.Event] = None
     tasks: List[asyncio.Task] = field(default_factory=list)
 
@@ -113,16 +139,20 @@ class PeerAgent:
                 int(i): bytes.fromhex(k["schnorr_pub"])
                 for i, k in all_keys.items()
             }
+            self.noise_pubs = {
+                int(i): bytes.fromhex(k["vrf_noise_pub"])
+                for i, k in all_keys.items()
+            }
             self.commit_key = keygen.load_commit_key(key_dir)
         else:
             self.schnorr_seed = hashlib.sha256(
                 f"schnorr-{cfg.seed}-{self.id}".encode()).digest()
             self.noise_vrf = VRFKey(hashlib.sha256(
                 f"vrf-noise-{cfg.seed}-{self.id}".encode()).digest())
-            self.node_pubs = {
-                i: hashlib.sha256(f"schnorr-{cfg.seed}-{i}".encode()).digest()
-                for i in range(cfg.num_nodes)
-            }  # placeholder publics; real deployments pass key_dir
+            pubs = {i: _keyless_pubs(cfg.seed, i)
+                    for i in range(cfg.num_nodes)}
+            self.node_pubs = {i: p[0] for i, p in pubs.items()}
+            self.noise_pubs = {i: p[1] for i, p in pubs.items()}
             self.commit_key = None
 
         self.timeouts = cfg.timeouts  # already-scaled instance may be passed
@@ -131,6 +161,11 @@ class PeerAgent:
         self.round = RoundState(iteration=self.chain.next_iteration)
         self.role_map = R.RoleMap({i: 1 for i in range(cfg.num_nodes)})
         self.logs: List[Tuple[int, float, float]] = []  # iter, err, ts
+        # per-event counters: every traced protocol event is tallied here so
+        # harnesses can assert on security/attack accounting without log
+        # scraping (ref: the reference prints attack counters at exit,
+        # main.go:1071-1088)
+        self.counters: Dict[str, int] = {}
         self._log_path = log_path
         self._events = open(log_path, "a") if log_path else None
         self._rng = random.Random(cfg.seed * 7919 + self.id)
@@ -147,6 +182,7 @@ class PeerAgent:
     def _trace(self, event: str, **kw) -> None:
         """Structured per-round event log (SURVEY.md §5.1: the TPU build's
         replacement for the reference's timestamped text logs)."""
+        self.counters[event] = self.counters.get(event, 0) + 1
         if self._events:
             rec = {"ts": time.time(), "node": self.id,
                    "iter": self.iteration, "event": event, **kw}
@@ -156,11 +192,55 @@ class PeerAgent:
     def _sign(self, message: bytes) -> bytes:
         return cm.schnorr_sign(self.schnorr_seed, message)
 
+    def _quantize_np(self, delta: np.ndarray) -> np.ndarray:
+        """Protocol-plane quantization (ref: kyber.go:698-710), done in
+        numpy on the host so worker commit and miner re-verify are
+        bit-identical regardless of which backend jitted the update."""
+        scale = 10.0 ** self.cfg.precision
+        return np.trunc(np.asarray(delta, np.float64) * scale).astype(np.int64)
+
     def _commit(self, q: np.ndarray) -> bytes:
         if self.commit_key is not None:
             return cm.commit_update(q, self.commit_key)
         # keyless local mode: binding-only hash commitment
         return hashlib.sha256(q.tobytes()).digest()
+
+    def _verify_plain_commitment(self, u: Update) -> bool:
+        """Miner-side recompute-and-compare (ref: kyber.go:564-577)."""
+        q = self._quantize_np(u.delta)
+        if self.commit_key is not None:
+            return cm.verify_commitment(u.commitment, q, self.commit_key)
+        return hashlib.sha256(q.tobytes()).digest() == u.commitment
+
+    @staticmethod
+    def _sig_message(commitment: bytes, iteration: int, source_id: int) -> bytes:
+        """Domain-separated verifier-approval message. Binding the iteration
+        and source prevents cross-round replay of an old approval (the
+        commitment alone is round-independent) and signature transplantation
+        between sources."""
+        return (b"biscotti-approve" + commitment
+                + int(iteration).to_bytes(8, "little", signed=True)
+                + int(source_id).to_bytes(8, "little", signed=True))
+
+    def _verify_sig_quorum(self, commitment: bytes, iteration: int,
+                           source_id: int, signers: List[int],
+                           signatures: List[bytes]) -> bool:
+        """≥ half the round's verifiers must have Schnorr-signed the
+        (commitment, iteration, source) approval message (ref: main.go:1686 —
+        the reference counts signatures; its miner-side verify,
+        kyber.go:898-925, was written but disabled. Here each claimed
+        (signer, sig) pair is actually verified)."""
+        msg = self._sig_message(commitment, iteration, source_id)
+        verifiers, _, _, _ = self.role_map.committee()
+        vset = set(verifiers)
+        valid: Set[int] = set()
+        for vid, sig in zip(signers, signatures):
+            if vid not in vset or vid in valid:
+                continue
+            pub = self.node_pubs.get(vid)
+            if pub and cm.schnorr_verify(pub, msg, sig):
+                valid.add(vid)
+        return len(valid) >= max(1, (len(vset) + 1) // 2)
 
     async def _call(self, peer_id: int, msg_type: str, meta=None, arrays=None,
                     timeout: Optional[float] = None):
@@ -185,17 +265,30 @@ class PeerAgent:
                                             miners=[0], noisers=[])
             return
         stake = self.chain.latest_stake_map()
-        verifiers, miners = R.elect_committees(
-            stake, self.chain.latest_hash(), cfg.num_verifiers,
-            cfg.num_miners, cfg.num_nodes)
+        try:
+            verifiers, miners = R.elect_committees(
+                stake, self.chain.latest_hash(), cfg.num_verifiers,
+                cfg.num_miners, cfg.num_nodes)
+        except ValueError:
+            # debits can zero out enough nodes that the staked population no
+            # longer covers the committees; fall back to a uniform one-
+            # ticket lottery — deterministic, so every peer still agrees
+            self._trace("lottery_uniform_fallback")
+            verifiers, miners = R.elect_committees(
+                {i: 1 for i in range(cfg.num_nodes)},
+                self.chain.latest_hash(), cfg.num_verifiers,
+                cfg.num_miners, cfg.num_nodes)
         self.role_map = R.RoleMap.build(cfg.num_nodes, verifiers, miners)
 
-    def _my_noisers(self) -> List[int]:
-        draw = R.elect_noisers(
+    def _noiser_draw(self) -> R.NoiserDraw:
+        """Private stake-weighted noiser lottery + the VRF proof that binds
+        it to (our key, latest block hash) — noisers verify the proof before
+        serving (ref: vrf.go:54-99 returns the proof; the capability its
+        returned-but-unchecked proof existed for)."""
+        return R.elect_noisers(
             self.noise_vrf, self.chain.latest_stake_map(),
             self.chain.latest_hash(), self.id, self.cfg.num_noisers,
             self.cfg.num_nodes)
-        return draw.noisers
 
     # ---------------------------------------------------------- RPC surface
 
@@ -298,8 +391,20 @@ class PeerAgent:
                 t = asyncio.get_running_loop().create_task(send(pid))
                 self.round.tasks.append(t)
 
+    def _reject_source(self, st: RoundState, sid: int, it: int,
+                       commitment: bytes, reason: str) -> None:
+        """Record a cryptographically invalid submission: carried into the
+        minted block as an accepted=False record and debited STAKE_UNIT
+        (ref: honest.go:363-370)."""
+        st.miner_rejected[sid] = Update(
+            source_id=sid, iteration=it, delta=np.zeros(0, np.float64),
+            commitment=commitment, accepted=False)
+        self._trace("submission_rejected", source=sid, reason=reason)
+
     async def _h_register_update(self, meta, arrays):
-        """Miner intake, plain mode (ref: main.go:420-436)."""
+        """Miner intake, plain mode (ref: main.go:420-436). The commitment
+        is recomputed from the received delta (ref: kyber.go:564-577) and
+        the verifier signature quorum is checked before acceptance."""
         it = int(meta["iteration"])
         if it < self.iteration:
             raise StaleError()
@@ -309,6 +414,19 @@ class PeerAgent:
         u = wire.unpack_update(meta, arrays)
         if len(u.delta) != self.trainer.num_params:
             raise RPCError("bad update dimension")
+        if u.source_id in st.miner_updates or u.source_id in st.miner_rejected:
+            return {}, {}
+        why = ""
+        if not self.cfg.fedsys:  # FedSys carries no crypto (ref: FedSys/)
+            if not await asyncio.to_thread(self._verify_plain_commitment, u):
+                why = "commitment recompute mismatch"
+            elif self.cfg.verification and not await asyncio.to_thread(
+                    self._verify_sig_quorum, u.commitment, it, u.source_id,
+                    u.signers, u.signatures):
+                why = "verifier signature quorum failed"
+        if why:
+            self._reject_source(st, u.source_id, it, u.commitment, why)
+            raise RPCError(f"update rejected: {why}")
         st.miner_updates.setdefault(u.source_id, u)
         self._trace("update_registered", source=u.source_id,
                     have=len(st.miner_updates))
@@ -316,7 +434,13 @@ class PeerAgent:
 
     async def _h_register_secret(self, meta, arrays):
         """Miner intake, secure-agg mode: one share-row slice per
-        contributor (ref: main.go:256-286, 330-367)."""
+        contributor (ref: main.go:256-286, 330-367). Every row is verified
+        against the sender's Pedersen-VSS chunk commitments before it can
+        enter aggregation (ref: kyber.go:650-673 verifySecret — there a
+        pairing check per share; here one batched random-linear-combination
+        MSM for the whole slice), and the commitment digest + verifier
+        signature quorum are checked so garbage shares, forged commitments
+        and unapproved updates are all refused at intake."""
         it = int(meta["iteration"])
         if it < self.iteration:
             raise StaleError()
@@ -324,23 +448,97 @@ class PeerAgent:
         if not self.role_map.is_miner(self.id):
             raise RPCError("not a miner this round")
         sid = int(meta["source_id"])
-        rows = np.asarray(arrays["share_rows"], dtype=np.int64)
+        commitment = bytes.fromhex(meta.get("commitment", ""))
+        if sid in st.miner_shares or sid in st.miner_rejected:
+            return {}, {}
+        rows = np.asarray(arrays.get("share_rows", np.zeros(0)), dtype=np.int64)
         expect = (self.cfg.shares_per_miner,
                   ss.num_chunks(self.trainer.num_params, self.cfg.poly_size))
         if rows.shape != expect:
             raise RPCError(f"bad share shape {rows.shape} != {expect}")
+        ok, why = await asyncio.to_thread(
+            self._check_secret, commitment, rows, meta, arrays)
+        if not ok:
+            self._reject_source(st, sid, it, commitment, why)
+            raise RPCError(f"secret rejected: {why}")
         st.miner_shares.setdefault(sid, rows)
-        st.miner_commitments[sid] = bytes.fromhex(meta.get("commitment", ""))
+        st.miner_commitments[sid] = commitment
         self._trace("secret_registered", source=sid,
                     have=len(st.miner_shares))
         return {}, {}
 
+    def _check_secret(self, commitment: bytes, rows: np.ndarray, meta,
+                      arrays) -> Tuple[bool, str]:
+        """Full cryptographic intake check for one RegisterSecret payload
+        (runs off the event loop)."""
+        cfg = self.cfg
+        comms = arrays.get("comms")
+        blind_rows = arrays.get("blind_rows")
+        if comms is None or blind_rows is None:
+            return False, "missing VSS tensors"
+        comms = np.asarray(comms, np.uint8)
+        blind_rows = np.asarray(blind_rows, np.uint8)
+        # the polynomial degree is bound by the protocol, not the sender: a
+        # higher-degree commitment would pass pointwise VSS checks while
+        # making poly_size-column least-squares recovery return garbage
+        c_expect = ss.num_chunks(self.trainer.num_params, cfg.poly_size)
+        if comms.shape != (c_expect, cfg.poly_size, 32):
+            return False, f"bad commitment tensor shape {comms.shape}"
+        if cm.vss_digest(comms) != commitment:
+            return False, "commitment digest mismatch"
+        _, miners, _, _ = self.role_map.committee()
+        idx = sorted(miners).index(self.id)
+        sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
+        xs = [i - ss.SHARE_OFFSET for i in range(cfg.total_shares)][sl]
+        if not cm.vss_verify_rows(comms, xs, rows, blind_rows):
+            return False, "share rows fail VSS verification"
+        if cfg.verification:
+            try:
+                signers = [int(x) for x in meta.get("signers", [])]
+                sigs = [bytes.fromhex(s) for s in meta.get("signatures", [])]
+            except (ValueError, TypeError):
+                return False, "malformed signature metadata"
+            if not self._verify_sig_quorum(commitment, int(meta["iteration"]),
+                                           int(meta["source_id"]),
+                                           signers, sigs):
+                return False, "verifier signature quorum failed"
+        return True, ""
+
     async def _h_request_noise(self, meta, arrays):
         """Noiser serving its presampled DP noise for the round
-        (ref: main.go:239-248 → honest.go:564-592)."""
+        (ref: main.go:239-248 → honest.go:564-592) — but only after
+        verifying the requester's lottery proof: the VRF output must verify
+        under the requester's noise key over OUR latest block hash, and the
+        draw it determines must actually include us. A peer who fabricates
+        its noiser set (e.g. to collect noise vectors it can cancel) is
+        refused (enforces the proof from ref vrf.go:54-99)."""
         it = int(meta["iteration"])
         if it < self.iteration:
             raise StaleError()
+        await self._wait_for_iteration(it)
+        if it < self.iteration:
+            raise StaleError()
+        sid = int(meta.get("source_id", -1))
+        try:
+            draw = R.NoiserDraw(
+                noisers=[int(x) for x in meta.get("noisers", [])],
+                output=bytes.fromhex(meta.get("vrf_output", "")),
+                proof=bytes.fromhex(meta.get("vrf_proof", "")),
+            )
+        except ValueError:
+            raise RPCError("malformed noiser draw")
+        pub = self.noise_pubs.get(sid)
+        ok = (
+            pub is not None
+            and self.id in draw.noisers
+            and sid != self.id
+            and await asyncio.to_thread(
+                R.verify_noiser_draw, pub, self.chain.latest_stake_map(),
+                self.chain.latest_hash(), sid, draw, self.cfg.num_nodes)
+        )
+        if not ok:
+            self._trace("noise_draw_rejected", source=sid)
+            raise RPCError("noiser lottery proof failed verification")
         noise = self.trainer.get_noise(it)
         return {}, {"noise": noise}
 
@@ -368,7 +566,7 @@ class PeerAgent:
         accepted = await asyncio.wait_for(
             asyncio.shield(st.krum_decision), self.timeouts.krum_s * 2)
         if u.source_id in accepted:
-            sig = self._sign(u.commitment or u.delta.tobytes())
+            sig = self._sign(self._sig_message(u.commitment, it, u.source_id))
             return {"signature": sig.hex()}, {}
         raise RPCError("rejected by defense")
 
@@ -427,7 +625,7 @@ class PeerAgent:
         it = int(meta["iteration"])
         st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
         srcs = sorted(st.miner_shares)
-        return {"sources": srcs}, {}
+        return {"sources": srcs, "rejected": sorted(st.miner_rejected)}, {}
 
     async def _h_get_miner_part(self, meta, arrays):
         """Leader-miner collects this miner's share slice, aggregated over
@@ -458,14 +656,17 @@ class PeerAgent:
             delta = delta + self.trainer.get_noise(it)
         noised = delta
         if cfg.noising and not cfg.fedsys:
+            draw = self._noiser_draw()
+            nmeta = {
+                "iteration": it, "source_id": self.id,
+                "noisers": list(draw.noisers),
+                "vrf_output": draw.output.hex(),
+                "vrf_proof": draw.proof.hex(),
+            }
             vectors = []
-            for nid in self._my_noisers():
-                if nid == self.id:
-                    vectors.append(self.trainer.get_noise(it))
-                    continue
+            for nid in draw.noisers:
                 try:
-                    _, arrs = await self._call(nid, "RequestNoise",
-                                               {"iteration": it})
+                    _, arrs = await self._call(nid, "RequestNoise", nmeta)
                     vectors.append(np.asarray(arrs["noise"], np.float64))
                 except Exception:
                     continue
@@ -473,8 +674,16 @@ class PeerAgent:
                 noise = np.mean(vectors, axis=0)
                 noised = delta + noise
 
-        q = np.asarray(ss.quantize(np.asarray(delta)))
-        commitment = self._commit(q)
+        q = self._quantize_np(delta)
+        vss = None
+        if cfg.secure_agg and not cfg.fedsys:
+            # commitment = digest over the per-chunk Pedersen VSS coefficient
+            # commitments: the exact object miners verify share rows against,
+            # so verifier signatures and share verification bind together
+            vss = await asyncio.to_thread(self._vss_build, q, it)
+            commitment = cm.vss_digest(vss[0])
+        else:
+            commitment = await asyncio.to_thread(self._commit, q)
         u = Update(source_id=self.id, iteration=it, delta=delta,
                    commitment=commitment, noise=noise, noised_delta=noised)
 
@@ -489,7 +698,7 @@ class PeerAgent:
                               delta=np.zeros(0, np.float64),
                               commitment=commitment, noised_delta=noised)
             meta, arrays = wire.pack_update(redacted)
-            sigs = []
+            sigs: List[Tuple[int, bytes]] = []
 
             async def ask(v):
                 try:
@@ -497,7 +706,7 @@ class PeerAgent:
                         v, "VerifyUpdateKRUM" if cfg.defense == Defense.KRUM
                         else "VerifyUpdateRONI", meta, arrays,
                         timeout=self.timeouts.krum_s * 2 + self.timeouts.rpc_s)
-                    sigs.append(bytes.fromhex(rmeta["signature"]))
+                    sigs.append((v, bytes.fromhex(rmeta["signature"])))
                 except Exception as e:
                     self._trace("verify_call_failed", verifier=v,
                                 error=f"{type(e).__name__}: {e}")
@@ -505,24 +714,27 @@ class PeerAgent:
             await asyncio.gather(*(ask(v) for v in verifiers))
             # approved iff ≥ half the verifiers signed (ref: main.go:1686)
             approved = len(sigs) >= max(1, (len(verifiers) + 1) // 2)
-            u.signatures = sigs
+            u.signers = [v for v, _ in sigs]
+            u.signatures = [s for _, s in sigs]
         if not approved:
             self._trace("update_rejected")
             return
 
         _, miners, _, _ = self.role_map.committee()
         if cfg.secure_agg and not cfg.fedsys:
+            comms, blind_rows = vss
             shares = np.asarray(ss.make_shares(
                 np.asarray(q), cfg.poly_size, cfg.total_shares))
             for idx, m in enumerate(sorted(miners)):
-                rows = shares[ss.miner_rows(cfg.total_shares, idx,
-                                            len(miners))]
+                sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
                 try:
                     await self._call(m, "RegisterSecret", {
                         "iteration": it, "source_id": self.id,
                         "miner_index": idx,
                         "commitment": commitment.hex(),
-                    }, {"share_rows": rows})
+                        "signers": list(u.signers),
+                        "signatures": [s.hex() for s in u.signatures],
+                    }, self._secret_arrays(shares, blind_rows, comms, sl))
                 except Exception:
                     pass
         else:
@@ -536,6 +748,29 @@ class PeerAgent:
                 for m in sorted(miners)
             ))
         self._trace("update_sent", secure_agg=cfg.secure_agg)
+
+    def _vss_build(self, q: np.ndarray, it: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Pedersen-VSS commitments for every polynomial chunk of the
+        quantized update plus the blinding-share tensor, bound to this round
+        via the (block hash, iteration) context. Returns
+        (comms uint8 [C,k,32], blind_rows uint8 [S,C,32])."""
+        cfg = self.cfg
+        c = ss.num_chunks(len(q), cfg.poly_size)
+        padded = np.zeros(c * cfg.poly_size, np.int64)
+        padded[: len(q)] = q
+        chunks = padded.reshape(c, cfg.poly_size)
+        context = self.chain.latest_hash() + int(it).to_bytes(8, "little")
+        comms, blinds = cm.vss_commit_chunks(chunks, self.schnorr_seed, context)
+        xs = [int(x) - ss.SHARE_OFFSET for x in range(cfg.total_shares)]
+        blind_rows = cm.vss_blind_rows(blinds, xs)
+        return comms, blind_rows
+
+    def _secret_arrays(self, shares: np.ndarray, blind_rows: np.ndarray,
+                       comms: np.ndarray, sl: slice) -> Dict[str, np.ndarray]:
+        """Per-miner RegisterSecret payload — seam overridden by Byzantine
+        test peers to inject corrupted tensors."""
+        return {"share_rows": shares[sl], "blind_rows": blind_rows[sl],
+                "comms": comms}
 
     async def _safe_call(self, pid, msg_type, meta=None, arrays=None) -> bool:
         try:
@@ -561,10 +796,21 @@ class PeerAgent:
         # plain/FedSys waits for the full sample count (ref: FedSys/main.go:530-558)
         target = max(1, cfg.num_samples // 2) if sec else max(1, cfg.num_samples)
         t0 = time.monotonic()
+        grace_until = None
         while time.monotonic() - t0 < deadline:
             have = len(st.miner_shares) if sec else len(st.miner_updates)
-            if have >= target:
+            # every expected contributor has responded (incl. provably bad
+            # submissions): mint at once
+            if have + len(st.miner_rejected) >= cfg.num_samples:
                 break
+            if have >= target:
+                # quorum reached — hold a short straggler window so
+                # same-instant submissions (and their rejections) land in
+                # this block rather than silently missing the round
+                if grace_until is None:
+                    grace_until = time.monotonic() + min(1.0, deadline / 4)
+                elif time.monotonic() >= grace_until:
+                    break
             if st.block_done and st.block_done.is_set():
                 return  # someone else minted first
             await asyncio.sleep(0.05)
@@ -583,6 +829,11 @@ class PeerAgent:
         w = self.chain.latest_gradient()
         stake = self.chain.latest_stake_map()
 
+        # Debits are backed ONLY by this leader's own verification evidence
+        # (st.miner_rejected): trusting other miners' claimed rejection
+        # lists would let a single Byzantine miner zero out arbitrary
+        # nodes' stake every round.
+        rejected_ids: Set[int] = set(st.miner_rejected)
         if cfg.secure_agg and not cfg.fedsys:
             _, miners, _, _ = self.role_map.committee()
             miners = sorted(miners)
@@ -598,59 +849,73 @@ class PeerAgent:
                 except Exception:
                     node_sets.append(set())
             nodes = sorted(set.intersection(*node_sets)) if node_sets else []
-            if not nodes:
-                return self._empty_block()
-            # 2. gather every miner's aggregated slice
-            slices: Dict[int, np.ndarray] = {}
-            ok = True
-            for idx, m in enumerate(miners):
-                if m == self.id:
-                    stack = np.stack([self.round.miner_shares[n] for n in nodes])
-                    slices[idx] = np.asarray(ss.aggregate_shares(stack))
-                    continue
-                try:
-                    _, arrs = await self._call(
-                        m, "GetMinerPart", {"iteration": it, "nodes": nodes})
-                    slices[idx] = np.asarray(arrs["agg_rows"], np.int64)
-                except Exception:
-                    ok = False
-            if not ok or len(slices) != len(miners):
-                return self._empty_block()
-            # 3. reassemble rows and recover the aggregate on device
-            full = np.concatenate([slices[i] for i in range(len(miners))])
-            xs = np.asarray(ss.share_xs(cfg.total_shares))
-            agg = np.asarray(ss.recover_update(
-                full, xs, self.trainer.num_params, cfg.poly_size,
-                cfg.precision))
+            agg = np.zeros(self.trainer.num_params, np.float64)
+            if nodes:
+                # 2. gather every miner's aggregated slice
+                slices: Dict[int, np.ndarray] = {}
+                ok = True
+                for idx, m in enumerate(miners):
+                    if m == self.id:
+                        stack = np.stack([self.round.miner_shares[n]
+                                          for n in nodes])
+                        slices[idx] = np.asarray(ss.aggregate_shares(stack))
+                        continue
+                    try:
+                        _, arrs = await self._call(
+                            m, "GetMinerPart",
+                            {"iteration": it, "nodes": nodes})
+                        slices[idx] = np.asarray(arrs["agg_rows"], np.int64)
+                    except Exception:
+                        ok = False
+                if not ok or len(slices) != len(miners):
+                    return self._empty_block()
+                # 3. reassemble rows and recover the aggregate
+                full = np.concatenate([slices[i] for i in range(len(miners))])
+                xs = np.asarray(ss.share_xs(cfg.total_shares))
+                agg = np.asarray(ss.recover_update(
+                    full, xs, self.trainer.num_params, cfg.poly_size,
+                    cfg.precision))
             deltas = [Update(source_id=n, iteration=it,
                              delta=np.zeros(0, np.float64),
                              commitment=self.round.miner_commitments.get(n, b""),
                              accepted=True)
                       for n in nodes]
-            contributors = nodes
+            contributors = list(nodes)
         else:
             updates = [st.miner_updates[k] for k in sorted(st.miner_updates)]
-            if not updates:
-                return self._empty_block()
-            mat = np.stack([u.delta for u in updates])
-            if cfg.fedsys:
-                agg = mat.mean(axis=0)  # FedSys averages (FedSys/honest.go:311)
-            else:
-                agg = mat.sum(axis=0)  # Biscotti sums (honest.go:360-375)
-            for u in updates:
-                u.accepted = True
+            agg = np.zeros(self.trainer.num_params, np.float64)
+            if updates:
+                mat = np.stack([u.delta for u in updates])
+                if cfg.fedsys:
+                    agg = mat.mean(axis=0)  # FedSys averages (FedSys/honest.go:311)
+                else:
+                    agg = mat.sum(axis=0)  # Biscotti sums (honest.go:360-375)
+                for u in updates:
+                    u.accepted = True
             deltas = updates
             contributors = [u.source_id for u in updates]
 
+        rejected_ids -= set(contributors)
+        if not contributors and not rejected_ids:
+            return self._empty_block()
+        # rejected submissions ride in the block as accepted=False records
+        # and are debited, mirroring the reference's block-level stake
+        # update (ref: honest.go:363-370: +STAKE_UNIT accepted, − rejected);
+        # stake is floored at zero so repeat offenders cannot push the
+        # lottery ticket pool negative
+        deltas = deltas + [st.miner_rejected[n] for n in sorted(rejected_ids)]
         new_stake = dict(stake)
         for n in contributors:
             new_stake[n] = new_stake.get(n, 0) + cfg.stake_unit
+        for n in rejected_ids:
+            new_stake[n] = max(0, new_stake.get(n, 0) - cfg.stake_unit)
         blk = Block(
             data=BlockData(iteration=it, global_w=w + agg, deltas=deltas),
             prev_hash=self.chain.latest_hash(),
             stake_map=new_stake,
         ).seal()
-        self._trace("block_minted", contributors=len(contributors))
+        self._trace("block_minted", contributors=len(contributors),
+                    rejected=len(rejected_ids))
         return blk
 
     def _empty_block(self) -> Block:

@@ -21,6 +21,7 @@ def pack_update(u: Update, prefix: str = "u") -> Tuple[Dict[str, Any], Dict[str,
         "commitment": u.commitment.hex(),
         "accepted": u.accepted,
         "signatures": [s.hex() for s in u.signatures],
+        "signers": list(u.signers),
         "has_noise": u.noise is not None,
         "has_noised": u.noised_delta is not None,
     }
@@ -45,6 +46,7 @@ def unpack_update(meta: Dict[str, Any], arrays: Dict[str, np.ndarray],
         if meta.get("has_noised") else None,
         accepted=bool(meta.get("accepted", False)),
         signatures=[bytes.fromhex(s) for s in meta.get("signatures", [])],
+        signers=[int(s) for s in meta.get("signers", [])],
     )
 
 

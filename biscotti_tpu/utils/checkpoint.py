@@ -59,6 +59,7 @@ def _block_meta(blk: Block) -> Dict:
                 "commitment": u.commitment.hex(),
                 "accepted": u.accepted,
                 "signatures": [s.hex() for s in u.signatures],
+                "signers": list(u.signers),
             }
             for u in blk.data.deltas
         ],
@@ -134,6 +135,7 @@ def load(directory: str, step: Optional[int] = None) -> Blockchain:
                 if ndkey in arrays else None,
                 accepted=bool(d.get("accepted", False)),
                 signatures=[bytes.fromhex(s) for s in d.get("signatures", [])],
+                signers=[int(s) for s in d.get("signers", [])],
             ))
         blk = Block(
             data=BlockData(iteration=int(meta["iteration"]),

@@ -1,0 +1,228 @@
+"""Byzantine-peer integration tests: the crypto plane is ENFORCED in the
+protocol, not just implemented.
+
+Each test boots a real TCP-loopback cluster containing one Byzantine peer
+whose submissions are cryptographically invalid — corrupted share rows,
+a commitment forged over different data, a fabricated noiser lottery, or a
+bogus plain-mode commitment. The honest majority must (a) detect and refuse
+the bad submission at intake (ref: kyber.go:564-577 commitment recompute,
+kyber.go:650-673 share verification, vrf.go:54-99 lottery proof),
+(b) debit the offender's stake in the minted block
+(ref: honest.go:363-370), and (c) keep the chain-equality oracle intact
+(ref: localTest.sh:40-96).
+"""
+
+import asyncio
+
+import numpy as np
+
+from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+from biscotti_tpu.ledger.chain import Blockchain
+from biscotti_tpu.parallel import roles as R
+from biscotti_tpu.runtime.peer import PeerAgent
+
+FAST = Timeouts(update_s=4.0, block_s=20.0, krum_s=4.0, share_s=4.0, rpc_s=6.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=2, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+def _round0_vanilla(n, num_verifiers=1, num_miners=1, num_params=50):
+    """A node that is a plain worker in round 0 — the deterministic
+    committee draw lets the test pick a Byzantine id that actually submits
+    an update in the first round."""
+    chain = Blockchain(num_params, n, 10)
+    verifiers, miners = R.elect_committees(
+        chain.latest_stake_map(), chain.latest_hash(), num_verifiers,
+        num_miners, n)
+    busy = set(verifiers) | set(miners)
+    return max(i for i in range(n) if i not in busy)
+
+
+def _run_mixed_cluster(cfgs, byz_id, byz_cls):
+    async def go():
+        agents = [
+            byz_cls(c) if c.node_id == byz_id else PeerAgent(c) for c in cfgs
+        ]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    return asyncio.run(go())
+
+
+def _assert_detected_and_debited(results, agents, byz_id):
+    honest = [r for r, a in zip(results, agents) if a.id != byz_id]
+    dumps = [r["chain_dump"] for r in honest]
+    assert all(d == dumps[0] for d in dumps), "chain-equality oracle violated"
+    chain = next(a for a in agents if a.id != byz_id).chain
+    accepted = [u.source_id for b in chain.blocks for u in b.data.deltas
+                if u.accepted]
+    rejected = [u.source_id for b in chain.blocks for u in b.data.deltas
+                if not u.accepted]
+    assert byz_id not in accepted, "Byzantine update entered a block"
+    assert byz_id in rejected, "Byzantine update was not recorded as rejected"
+    assert accepted, "no honest update made it into any block"
+    final_stake = chain.latest_stake_map()
+    cfg = agents[0].cfg
+    assert final_stake[byz_id] < cfg.default_stake, (
+        f"Byzantine stake was not debited: {final_stake[byz_id]}")
+    assert any(a.counters.get("submission_rejected", 0) > 0 for a in agents
+               if a.id != byz_id)
+
+
+class CorruptSharePeer(PeerAgent):
+    """Commits honestly, then ships garbage share rows — VSS row
+    verification at the miner must catch the mismatch."""
+
+    def _secret_arrays(self, shares, blind_rows, comms, sl):
+        arrays = super()._secret_arrays(shares, blind_rows, comms, sl)
+        arrays["share_rows"] = arrays["share_rows"] + 12345
+        return arrays
+
+
+class ForgedCommitmentPeer(PeerAgent):
+    """Gets verifier signatures over a commitment to ZEROS while sharing its
+    real update — binding must fail at share verification."""
+
+    def _vss_build(self, q, it):
+        return super()._vss_build(np.zeros_like(q), it)
+
+
+class FakeLotteryPeer(PeerAgent):
+    """Claims a noiser set its VRF never drew (e.g. to target specific peers
+    and collect noise it can cancel) — noisers must refuse to serve."""
+
+    def _noiser_draw(self):
+        draw = super()._noiser_draw()
+        fake = [i for i in range(self.cfg.num_nodes)
+                if i != self.id and i not in draw.noisers]
+        picked = (fake or draw.noisers)[: len(draw.noisers)]
+        return R.NoiserDraw(noisers=picked, output=draw.output,
+                            proof=draw.proof)
+
+
+class BadCommitPeer(PeerAgent):
+    """Plain mode: ships a commitment unrelated to its delta — the miner's
+    recompute-and-compare must reject it."""
+
+    def _commit(self, q):
+        return b"\xde\xad" * 16
+
+
+def test_corrupt_shares_detected_and_debited():
+    n, port = 5, 25010
+    byz = _round0_vanilla(n)
+    # defense=NONE so the update passes the verifier committee — the
+    # corruption must be caught by the MINER's VSS share check, not Krum
+    cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
+                 defense=Defense.NONE, max_iterations=1) for i in range(n)]
+    results, agents = _run_mixed_cluster(cfgs, byz, CorruptSharePeer)
+    _assert_detected_and_debited(results, agents, byz)
+    reasons = [a.counters.get("submission_rejected", 0) for a in agents]
+    assert sum(reasons) >= 1
+
+
+def test_forged_commitment_detected_and_debited():
+    n, port = 5, 25020
+    byz = _round0_vanilla(n)
+    cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
+                 defense=Defense.NONE, max_iterations=1) for i in range(n)]
+    results, agents = _run_mixed_cluster(cfgs, byz, ForgedCommitmentPeer)
+    _assert_detected_and_debited(results, agents, byz)
+
+
+def test_fake_noiser_lottery_refused():
+    n, port = 5, 25030
+    byz = _round0_vanilla(n)
+    cfgs = [_cfg(i, n, port, noising=True, max_iterations=1)
+            for i in range(n)]
+    results, agents = _run_mixed_cluster(cfgs, byz, FakeLotteryPeer)
+    dumps = [r["chain_dump"] for r, a in zip(results, agents) if a.id != byz]
+    assert all(d == dumps[0] for d in dumps)
+    # at least one honest noiser saw and refused the fabricated draw
+    assert any(a.counters.get("noise_draw_rejected", 0) > 0 for a in agents
+               if a.id != byz), "no noiser rejected the fake lottery"
+    # honest requests were still served: rounds produced non-empty blocks
+    assert "ndeltas=0" not in dumps[0].splitlines()[1]
+
+
+def test_plain_mode_bad_commitment_detected_and_debited():
+    n, port = 5, 25040
+    byz = _round0_vanilla(n)
+    cfgs = [_cfg(i, n, port, max_iterations=1) for i in range(n)]
+    results, agents = _run_mixed_cluster(cfgs, byz, BadCommitPeer)
+    _assert_detected_and_debited(results, agents, byz)
+
+
+def test_high_degree_commitment_rejected():
+    # a commitment tensor with more coefficients than poly_size would pass
+    # pointwise VSS checks while corrupting least-squares recovery — the
+    # miner must refuse the tensor shape outright
+    import hashlib
+
+    from biscotti_tpu.crypto import commitments as cm
+    from biscotti_tpu.ops import secretshare as ss
+
+    cfg = _cfg(0, 3, 25060, secure_agg=True)
+    agent = PeerAgent(cfg)
+    agent.role_map = R.RoleMap.build(3, verifiers=[1], miners=[0])
+    c = ss.num_chunks(agent.trainer.num_params, cfg.poly_size)
+    comms = np.zeros((c, 2 * cfg.poly_size, 32), dtype=np.uint8)
+    commitment = cm.vss_digest(comms)
+    rows = np.zeros((cfg.shares_per_miner, c), dtype=np.int64)
+    blind = np.zeros((cfg.shares_per_miner, c, 32), dtype=np.uint8)
+    ok, why = agent._check_secret(
+        commitment, rows, {"iteration": 0, "source_id": 2},
+        {"comms": comms, "blind_rows": blind, "share_rows": rows})
+    assert not ok and "shape" in why
+
+
+def test_signature_replay_across_rounds_fails():
+    # verifier approvals are bound to (commitment, iteration, source):
+    # a signature collected in round 0 must not satisfy the quorum for a
+    # round-1 resubmission of the same update, nor for a different source
+    import hashlib
+
+    from biscotti_tpu.crypto import commitments as cm
+
+    cfg = _cfg(0, 3, 25070)
+    agent = PeerAgent(cfg)
+    agent.role_map = R.RoleMap.build(3, verifiers=[1], miners=[0])
+    v_seed = hashlib.sha256(f"schnorr-{cfg.seed}-1".encode()).digest()
+    commitment = b"\xab" * 32
+    sig = cm.schnorr_sign(v_seed, agent._sig_message(commitment, 0, 2))
+    assert agent._verify_sig_quorum(commitment, 0, 2, [1], [sig])
+    assert not agent._verify_sig_quorum(commitment, 1, 2, [1], [sig])
+    assert not agent._verify_sig_quorum(commitment, 0, 1, [1], [sig])
+
+
+def test_honest_secureagg_cluster_still_accepts_everyone():
+    # control: with no Byzantine peer the enforcement path accepts all
+    # submissions and nobody is debited
+    n, port = 5, 25050
+    cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
+                 noising=True, defense=Defense.KRUM, max_iterations=2)
+            for i in range(n)]
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    results, agents = asyncio.run(go())
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+    chain = agents[0].chain
+    assert all(u.accepted for b in chain.blocks for u in b.data.deltas)
+    stake = chain.latest_stake_map()
+    assert all(v >= agents[0].cfg.default_stake for v in stake.values())
+    assert sum(a.counters.get("submission_rejected", 0) for a in agents) == 0
