@@ -39,19 +39,33 @@ class Timeouts:
     share_s: float = 90.0
     rpc_s: float = 120.0
 
-    def scaled(self, num_nodes: int, num_verifiers: int, num_miners: int) -> "Timeouts":
-        # Larger meshes and committees need proportionally longer deadlines;
-        # the reference multiplies its base constants by ceil(N/100)-style
-        # factors (ref: DistSys/main.go:786-825). We scale linearly in the
-        # same spirit, clamped so small local tests stay fast.
-        f = max(1.0, num_nodes / 100.0) * max(1.0, (num_verifiers + num_miners) / 6.0)
-        return Timeouts(
-            update_s=self.update_s * f,
-            block_s=self.block_s * f,
-            krum_s=self.krum_s * f,
-            share_s=self.share_s * f,
-            rpc_s=self.rpc_s * f,
-        )
+    def scaled(self, num_nodes: int, num_verifiers: int, num_miners: int,
+               random_sampling: bool = False,
+               defense_is_krum: bool = True) -> "Timeouts":
+        """The reference's startup scaling, rule for rule
+        (ref: DistSys/main.go:786-825): the base constants are sized for
+        100 nodes; random sampling doubles RPC+update deadlines; committees
+        >10 at N=100 double the affected deadlines; N/100 (integer, so a
+        no-op below 200 nodes) multiplies everything."""
+        update_s, krum_s, rpc_s = self.update_s, self.krum_s, self.rpc_s
+        block_s, share_s = self.block_s, self.share_s
+        if defense_is_krum and random_sampling:
+            rpc_s *= 2  # ref: main.go:788-791
+            update_s *= 2
+        if num_miners > 10 and num_nodes == 100:
+            update_s *= 2  # ref: main.go:796-800
+        if num_verifiers > 10 and num_nodes == 100:
+            krum_s *= 2  # ref: main.go:802-807
+            update_s *= 2
+        mult = num_nodes // 100  # ref: main.go:810-825 (integer division)
+        if mult >= 1:
+            update_s *= mult
+            krum_s *= mult
+            block_s *= mult
+            rpc_s *= mult
+            share_s *= mult
+        return Timeouts(update_s=update_s, block_s=block_s, krum_s=krum_s,
+                        share_s=share_s, rpc_s=rpc_s)
 
 
 @dataclass

@@ -62,6 +62,30 @@ def _selfcheck(lib: ctypes.CDLL) -> bool:
     return ed.point_equal((x, y, 1, (x * y) % ed.P), expect)
 
 
+def _try_load(full: str) -> Optional[ctypes.CDLL]:
+    try:
+        lib = ctypes.CDLL(full)
+        lib.ed25519_msm.restype = ctypes.c_int
+        lib.ed25519_msm.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p,
+        ]
+        lib.ed25519_batch_commit.restype = ctypes.c_int
+        lib.ed25519_batch_commit.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.ed25519_decompress_batch.restype = ctypes.c_int
+        lib.ed25519_decompress_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        if not _selfcheck(lib):
+            return None
+        return lib
+    except (OSError, AttributeError):
+        return None
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_attempted
     if _load_attempted:
@@ -71,25 +95,18 @@ def _load() -> Optional[ctypes.CDLL]:
         _build()
     for path in _LIB_PATHS:
         full = os.path.abspath(path)
-        if os.path.exists(full):
-            try:
-                lib = ctypes.CDLL(full)
-                lib.ed25519_msm.restype = ctypes.c_int
-                lib.ed25519_msm.argtypes = [
-                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-                    ctypes.c_char_p,
-                ]
-                lib.ed25519_batch_commit.restype = ctypes.c_int
-                lib.ed25519_batch_commit.argtypes = [
-                    ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-                    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
-                ]
-                if not _selfcheck(lib):
-                    continue
-                _lib = lib
-                break
-            except (OSError, AttributeError):
-                continue
+        if not os.path.exists(full):
+            continue
+        lib = _try_load(full)
+        if lib is None:
+            # a stale binary (missing symbols / failed self-check): rebuild
+            # from source once and retry — make's dependency tracking
+            # refreshes the .so when the .cpp is newer
+            _build()
+            lib = _try_load(full)
+        if lib is not None:
+            _lib = lib
+            break
     return _lib
 
 
@@ -130,6 +147,37 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
         return ed.IDENTITY
     out = ctypes.create_string_buffer(64)
     rc = lib.ed25519_msm(bytes(sbuf), bytes(pbuf), n, out)
+    if rc != 0:
+        raise RuntimeError(f"native msm failed: {rc}")
+    x = int.from_bytes(out.raw[:32], "little")
+    y = int.from_bytes(out.raw[32:], "little")
+    return (x, y, 1, (x * y) % ed.P)
+
+
+def decompress_batch(comp: bytes, n: int) -> Optional[bytes]:
+    """n×32B compressed points → n×128B extended buffer (ed25519_msm's
+    input format), or None if any encoding is invalid/off-curve."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(comp) != 32 * n:
+        raise ValueError("compressed buffer length mismatch")
+    out = ctypes.create_string_buffer(128 * n)
+    rc = lib.ed25519_decompress_batch(comp, n, out)
+    if rc != 0:
+        return None
+    return out.raw
+
+
+def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
+    """MSM over an already-decompressed 128B/point buffer (from
+    decompress_batch) — skips the per-point python int marshalling."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(points_buf) != 128 * n or len(scalars) != n:
+        raise ValueError("buffer length mismatch")
+    sbuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in scalars)
+    out = ctypes.create_string_buffer(64)
+    rc = lib.ed25519_msm(sbuf, points_buf, n, out)
     if rc != 0:
         raise RuntimeError(f"native msm failed: {rc}")
     x = int.from_bytes(out.raw[:32], "little")

@@ -318,16 +318,23 @@ def vss_digest(comms: np.ndarray) -> bytes:
 def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
     """Evaluate every chunk's blinding polynomial at every share point:
     uint8 [S, C, 32] (little-endian Z_q values), the companion tensor to the
-    int64 share matrix."""
+    int64 share matrix.
+
+    Horner runs over the SIGNED small x with one reduction at the end: the
+    share points satisfy |x| ≤ S, so the unreduced accumulator stays under
+    q·(k·S^k) ≈ 2³⁰⁰ — cheap python-int small-multiplies instead of k
+    full-width modmuls per cell (x mod q is a 252-bit number for negative
+    x, which made the naive version the pipeline's hot spot)."""
     s, c = len(xs), len(blinds)
     out = np.zeros((s, c, 32), dtype=np.uint8)
     for si, x in enumerate(xs):
-        xq = int(x) % _Q
+        xi = int(x)
         for ci, coeffs in enumerate(blinds):
             acc = 0
             for bj in reversed(coeffs):
-                acc = (acc * xq + bj) % _Q
-            out[si, ci] = np.frombuffer(acc.to_bytes(32, "little"), np.uint8)
+                acc = acc * xi + bj
+            out[si, ci] = np.frombuffer((acc % _Q).to_bytes(32, "little"),
+                                        np.uint8)
     return out
 
 
@@ -351,40 +358,64 @@ def vss_verify_rows(comms: np.ndarray, xs: Sequence[int],
     if len(entropy) < 16 * rows.size:
         return False
 
-    # decompress commitment points once (refuse invalid encodings)
-    pts: List[ed.Point] = []
+    # decompress commitment points once (refuse invalid encodings); the
+    # native batch path matters — at d=7,850 pure-python decompression (a
+    # sqrt mod p per point) costs more than the MSM itself
     comm_bytes = np.ascontiguousarray(comms).tobytes()
-    for i in range(c_chunks * k):
-        p = ed.point_decompress(comm_bytes[32 * i: 32 * i + 32])
-        if p is None:
-            return False
-        pts.append(p)
+    pts: List[ed.Point] = []
+    pts_buf: Optional[bytes] = None
+    try:
+        from biscotti_tpu.crypto import _native
+
+        if _native.available():
+            pts_buf = _native.decompress_batch(comm_bytes, c_chunks * k)
+            if pts_buf is None:
+                return False
+    except ImportError:
+        pass
+    if pts_buf is None:
+        for i in range(c_chunks * k):
+            p = ed.point_decompress(comm_bytes[32 * i: 32 * i + 32])
+            if p is None:
+                return False
+            pts.append(p)
 
     gammas = [
         int.from_bytes(entropy[16 * i: 16 * (i + 1)], "little") | 1
         for i in range(rows.size)
     ]
+    # All accumulation runs over plain (signed) python ints with a single
+    # mod-q reduction per accumulator at the end: x is small (|x| ≤ S), so
+    # g·xʲ stays ≲ 2¹⁷³ and full-width modmuls — the hot cost at mnist
+    # scale — are avoided entirely.
     s_tot = 0
     t_tot = 0
-    # per-chunk accumulated scalar for each commitment point
-    coeff = [0] * (c_chunks * k)
+    coeff = [0] * (c_chunks * k)  # accumulated scalar per commitment point
     gi = 0
+    blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
     for r, x in enumerate(xs):
-        xq = int(x) % _Q
+        xi = int(x)
         for ci in range(c_chunks):
             g = gammas[gi]
             gi += 1
-            s_tot = (s_tot + g * int(rows[r, ci])) % _Q
-            t_val = int.from_bytes(bytes(blind_rows[r, ci]), "little")
+            s_tot += g * int(rows[r, ci])
+            off = 32 * (r * c_chunks + ci)
+            t_val = int.from_bytes(blind_bytes[off: off + 32], "little")
             if t_val >= _Q:
                 return False
-            t_tot = (t_tot + g * t_val) % _Q
+            t_tot += g * t_val
             xj = g
+            base = ci * k
             for j in range(k):
-                idx = ci * k + j
-                coeff[idx] = (coeff[idx] + xj) % _Q
-                xj = (xj * xq) % _Q
-    lhs = ed.point_add(ed.base_mult(s_tot),
-                       ed.scalar_mult(t_tot, H_POINT))
-    rhs = msm(coeff, pts)
+                coeff[base + j] += xj
+                xj *= xi
+    lhs = ed.point_add(ed.base_mult(s_tot % _Q),
+                       ed.scalar_mult(t_tot % _Q, H_POINT))
+    scalars = [v % _Q for v in coeff]
+    if pts_buf is not None:
+        from biscotti_tpu.crypto import _native
+
+        rhs = _native.msm_raw(scalars, pts_buf, c_chunks * k)
+    else:
+        rhs = msm(scalars, pts)
     return ed.point_equal(lhs, rhs)

@@ -69,8 +69,51 @@ def sample_batch(key: jax.Array, n: int, batch_size: int) -> jax.Array:
     return jax.random.choice(key, n, (min(batch_size, n),), replace=False)
 
 
+# Compiled-function cache shared by every Trainer with the same
+# (model, step rule) — N peers of one cluster reuse ONE XLA executable per
+# function instead of tracing N closures that differ only in their captured
+# shard constants. At N=100 the per-peer closures serialized ~100 identical
+# mnist compilations behind the GIL and stalled the first round for minutes;
+# passing the shard as an argument makes the trace shape-polymorphic-enough
+# (same shapes → same executable) and startup O(1) compilations.
+_FN_CACHE: dict = {}
+
+
+def _compiled_fns(model: Model, mode: str, clip: float, alpha: float,
+                  cache_key=None):
+    if cache_key is not None and cache_key in _FN_CACHE:
+        return _FN_CACHE[cache_key]
+    step = local_step_fn(model, mode, clip=clip, alpha=alpha)
+
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("batch_size",))
+    def _private(flat_w, it, x_train, y_train, batch_key, batch_size):
+        k = jax.random.fold_in(batch_key, it)
+        idx = sample_batch(k, x_train.shape[0], batch_size)
+        return step(flat_w, x_train[idx], y_train[idx])
+
+    @jax.jit
+    def _err(flat_w, x, y):
+        return model.error_flat(flat_w, x, y)
+
+    @jax.jit
+    def _roni(flat_w, delta, x, y):
+        # score = err(w+δ) − err(w) on the local train split
+        # (ref: client_obj.py:100-112; rejected if > 0.02, main.go:203-231)
+        before = model.error_flat(flat_w, x, y)
+        after = model.error_flat(flat_w + delta, x, y)
+        return after - before
+
+    fns = (_private, _err, _roni)
+    if cache_key is not None:
+        _FN_CACHE[cache_key] = fns
+    return fns
+
+
 class Trainer:
-    """One peer's ML state: shard on device, jitted step/metric functions."""
+    """One peer's ML state: shard on device, shared jitted step/metric
+    functions (see _compiled_fns)."""
 
     def __init__(self, dataset: str, shard: str, cfg=None, model: Model = None,
                  seed: int = None):
@@ -109,30 +152,15 @@ class Trainer:
         )
 
         alpha = self.cfg.logreg_alpha
-        step = local_step_fn(self.model, self.mode, clip=self.cfg.grad_clip,
-                             alpha=alpha)
-
-        @jax.jit
-        def _private_fun(flat_w, it):
-            k = jax.random.fold_in(batch_key, it)
-            idx = sample_batch(k, self.x_train.shape[0], self.batch_size)
-            return step(flat_w, self.x_train[idx], self.y_train[idx])
-
-        @jax.jit
-        def _err(flat_w, x, y):
-            return self.model.error_flat(flat_w, x, y)
-
-        @jax.jit
-        def _roni(flat_w, delta):
-            # score = err(w+δ) − err(w) on the local train split
-            # (ref: client_obj.py:100-112; rejected if > 0.02, main.go:203-231)
-            before = self.model.error_flat(flat_w, self.x_train, self.y_train)
-            after = self.model.error_flat(flat_w + delta, self.x_train, self.y_train)
-            return after - before
-
-        self._private_fun = _private_fun
-        self._err = _err
-        self._roni = _roni
+        self._batch_key = batch_key
+        # share compiled functions across peers of the same (zoo model,
+        # step-rule) family; a caller-supplied custom model skips the cache
+        cache_key = ((dataset, self.model.name, self.mode,
+                      self.cfg.grad_clip, alpha)
+                     if model is None else None)
+        self._private, self._err_fn, self._roni_fn = _compiled_fns(
+            self.model, self.mode, self.cfg.grad_clip, alpha,
+            cache_key=cache_key)
 
     # ---- reference bridge API (honest.go:204-324 surface) ----
 
@@ -142,7 +170,10 @@ class Trainer:
 
     def private_fun(self, flat_w: np.ndarray, iteration: int) -> np.ndarray:
         return np.asarray(
-            self._private_fun(jnp.asarray(flat_w, jnp.float32), iteration),
+            self._private(jnp.asarray(flat_w, jnp.float32), iteration,
+                          self.x_train, self.y_train, self._batch_key,
+                          batch_size=min(self.batch_size,
+                                         int(self.x_train.shape[0]))),
             dtype=np.float64,
         )
 
@@ -154,20 +185,20 @@ class Trainer:
         )
 
     def train_error(self, flat_w: np.ndarray) -> float:
-        return float(self._err(jnp.asarray(flat_w, jnp.float32),
-                               self.x_train, self.y_train))
+        return float(self._err_fn(jnp.asarray(flat_w, jnp.float32),
+                                  self.x_train, self.y_train))
 
     def test_error(self, flat_w: np.ndarray) -> float:
-        return float(self._err(jnp.asarray(flat_w, jnp.float32),
-                               self.x_test, self.y_test))
+        return float(self._err_fn(jnp.asarray(flat_w, jnp.float32),
+                                  self.x_test, self.y_test))
 
     def attack_rate(self, flat_w: np.ndarray) -> float:
         """Reference-faithful metric: 1 − accuracy on the attack-source split
         (ref: client.py:163-172 get17AttackRate is literally
         1 − accuracy_score on the digit-1 loader). Counts *any*
         misclassification of source-class samples."""
-        return float(self._err(jnp.asarray(flat_w, jnp.float32),
-                               self.x_attack, self.y_attack))
+        return float(self._err_fn(jnp.asarray(flat_w, jnp.float32),
+                                  self.x_attack, self.y_attack))
 
     def attack_success_rate(self, flat_w: np.ndarray) -> float:
         """Stricter 1→7 metric: fraction of attack-source samples predicted
@@ -182,5 +213,6 @@ class Trainer:
         return float(jnp.mean((pred == target).astype(jnp.float32)))
 
     def roni(self, flat_w: np.ndarray, delta: np.ndarray) -> float:
-        return float(self._roni(jnp.asarray(flat_w, jnp.float32),
-                                jnp.asarray(delta, jnp.float32)))
+        return float(self._roni_fn(jnp.asarray(flat_w, jnp.float32),
+                                   jnp.asarray(delta, jnp.float32),
+                                   self.x_train, self.y_train))

@@ -156,6 +156,7 @@ class PeerAgent:
             self.commit_key = None
 
         self.timeouts = cfg.timeouts  # already-scaled instance may be passed
+        self.pool = rpc.Pool()  # persistent multiplexed connections
         self.server = rpc.RPCServer(cfg.my_ip, cfg.port_of(self.id),
                                     self._handle)
         self.round = RoundState(iteration=self.chain.next_iteration)
@@ -248,8 +249,8 @@ class PeerAgent:
         (ref: main.go:1460-1487)."""
         host, port = self.peers[peer_id]
         try:
-            return await rpc.call(host, port, msg_type, meta, arrays,
-                                  timeout or self.timeouts.rpc_s)
+            return await self.pool.call(host, port, msg_type, meta, arrays,
+                                        timeout or self.timeouts.rpc_s)
         except (asyncio.TimeoutError, ConnectionError, OSError):
             self.alive.discard(peer_id)
             raise
@@ -296,6 +297,8 @@ class PeerAgent:
         dispatch = {
             "RegisterPeer": self._h_register_peer,
             "RegisterBlock": self._h_register_block,
+            "AdvertiseBlock": self._h_advertise_block,
+            "GetBlock": self._h_get_block,
             "RegisterUpdate": self._h_register_update,
             "RegisterSecret": self._h_register_secret,
             "RequestNoise": self._h_request_noise,
@@ -351,7 +354,46 @@ class PeerAgent:
         self._accept_block(blk, gossip=True)
         return {}, {}
 
-    def _accept_block(self, blk: Block, gossip: bool) -> None:
+    async def _h_advertise_block(self, meta, arrays):
+        """Header-only gossip: pull the body from the advertiser iff we do
+        not already hold this block (see _gossip_block)."""
+        it = int(meta["iteration"])
+        h = bytes.fromhex(meta.get("hash", ""))
+        src = int(meta.get("source_id", -1))
+        have = self.chain.get_block(it)
+        if have is not None and have.hash == h:
+            return {}, {}
+        if src not in self.peers:
+            return {}, {}
+
+        async def pull():
+            try:
+                bmeta, barrays = await self._call(
+                    src, "GetBlock", {"iteration": it},
+                    timeout=self.timeouts.rpc_s)
+                blk = wire.unpack_block(bmeta, barrays)
+                if blk.hash == blk.compute_hash():
+                    self._accept_block(blk, gossip=True)
+            except Exception:
+                pass
+
+        t = asyncio.get_running_loop().create_task(pull())
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+        return {}, {}
+
+    async def _h_get_block(self, meta, arrays):
+        """Serve a block body to a puller (the chain doubles as the block
+        store; ref: the reference serves its chain via RegisterPeer,
+        main.go:431-433 — this is the single-block variant)."""
+        it = int(meta["iteration"])
+        blk = self.chain.get_block(it)
+        if blk is None:
+            raise RPCError(f"no block at iteration {it}")
+        return wire.pack_block(blk)
+
+    def _accept_block(self, blk: Block, gossip: bool,
+                      minted: bool = False) -> None:
         if blk.iteration > self.iteration:
             # future block: we're behind — park it and retry as we catch up
             # (ref: main.go:1300-1320 sleep-loop)
@@ -366,7 +408,8 @@ class PeerAgent:
             if self.round.block_done and blk.iteration >= self.round.iteration:
                 self.round.block_done.set()
             if gossip:
-                self._gossip_block(blk)
+                # minted here → full fan-out; received → bounded re-gossip
+                self._gossip_block(blk, full=minted)
 
     async def _late_accept(self, blk: Block, budget: float = 20.0) -> None:
         deadline = time.monotonic() + budget
@@ -375,21 +418,59 @@ class PeerAgent:
         if blk.iteration <= self.iteration:
             self._accept_block(blk, gossip=False)
 
-    def _gossip_block(self, blk: Block) -> None:
-        """Re-gossip on append (ref: main.go:1390,1410-1418)."""
-        meta, arrays = wire.pack_block(blk)
+    def _gossip_block(self, blk: Block, full: bool = False) -> None:
+        """Block propagation, two-tier. The MINTER pushes the full block to
+        every live peer (ref: main.go:1410-1418), encoding the frame ONCE
+        and writing the same bytes to each connection. RECEIVERS do not
+        re-broadcast the multi-MB body (the reference re-gossips whole
+        blocks on append, main.go:1390 — O(N²) bodies); they advertise the
+        (iteration, hash) header to a log-sized random subset, and anyone
+        missing the block pulls it. Same epidemic coverage, but the body
+        crosses the wire O(N) times instead of O(N·fanout)."""
+        targets = [pid for pid in self.alive if pid != self.id]
+        if full:
+            from biscotti_tpu.runtime import messages as msgs
 
-        async def send(pid):
+            meta, arrays = wire.pack_block(blk)
+            meta["rid"] = 0
+            frame = msgs.encode("RegisterBlock", meta, arrays)
+
+            async def push(pid):
+                host, port = self.peers[pid]
+                try:
+                    await self.pool.post(host, port, frame,
+                                         timeout=self.timeouts.rpc_s)
+                except Exception:
+                    self.alive.discard(pid)
+
+            # gossip outlives the round on purpose (stragglers still need
+            # the block); _bg_tasks holds the strong ref and the bounded
+            # send in rpc.py caps each task's lifetime at rpc_s
+            for pid in targets:
+                t = asyncio.get_running_loop().create_task(push(pid))
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
+            return
+
+        import math
+
+        fanout = max(3, int(math.log2(max(2, len(targets)))) + 1)
+        if len(targets) > fanout:
+            targets = self._rng.sample(targets, fanout)
+        ad = {"iteration": blk.iteration, "hash": blk.hash.hex(),
+              "source_id": self.id}
+
+        async def advertise(pid):
             try:
-                await self._call(pid, "RegisterBlock", meta, arrays,
+                await self._call(pid, "AdvertiseBlock", ad,
                                  timeout=self.timeouts.rpc_s)
             except Exception:
                 pass
 
-        for pid in list(self.alive):
-            if pid != self.id:
-                t = asyncio.get_running_loop().create_task(send(pid))
-                self.round.tasks.append(t)
+        for pid in targets:
+            t = asyncio.get_running_loop().create_task(advertise(pid))
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
 
     def _reject_source(self, st: RoundState, sid: int, it: int,
                        commitment: bytes, reason: str) -> None:
@@ -792,9 +873,13 @@ class PeerAgent:
         _, miners, _, _ = self.role_map.committee()
         sec = cfg.secure_agg and not cfg.fedsys
         deadline = self.timeouts.share_s if sec else self.timeouts.update_s
-        # secure-agg triggers at NUM_SAMPLES/2 shares (ref: main.go:345-363);
-        # plain/FedSys waits for the full sample count (ref: FedSys/main.go:530-558)
-        target = max(1, cfg.num_samples // 2) if sec else max(1, cfg.num_samples)
+        # both intake paths trigger at NUM_SAMPLES/2 — Krum approves about
+        # half the pool (f=0.5·n), so a full-sample target would always ride
+        # the deadline (ref: main.go:345-363 shares, main.go:1222-1230
+        # updates); FedSys's leader waits for the full sample count
+        # (ref: FedSys/main.go:530-558)
+        target = (max(1, cfg.num_samples) if cfg.fedsys
+                  else max(1, cfg.num_samples // 2))
         t0 = time.monotonic()
         grace_until = None
         while time.monotonic() - t0 < deadline:
@@ -820,7 +905,7 @@ class PeerAgent:
             return
         blk = await self._create_block()
         if blk is not None:
-            self._accept_block(blk, gossip=True)
+            self._accept_block(blk, gossip=True, minted=True)
 
     async def _create_block(self) -> Optional[Block]:
         cfg = self.cfg
@@ -892,6 +977,11 @@ class PeerAgent:
                     agg = mat.sum(axis=0)  # Biscotti sums (honest.go:360-375)
                 for u in updates:
                     u.accepted = True
+                    # noise / noised_delta are worker→verifier transport
+                    # fields; carrying them in the minted block doubles its
+                    # wire size for no reader (the delta is the receipt)
+                    u.noise = None
+                    u.noised_delta = None
             deltas = updates
             contributors = [u.source_id for u in updates]
 
@@ -970,7 +1060,8 @@ class PeerAgent:
         except asyncio.TimeoutError:
             if self.iteration == it:
                 self._trace("block_timeout_empty_fallback")
-                self._accept_block(self._empty_block(), gossip=True)
+                self._accept_block(self._empty_block(), gossip=True,
+                                   minted=True)
         if not st.krum_decision.done():
             st.krum_decision.set_result(set())
         for t in work:
@@ -990,11 +1081,12 @@ class PeerAgent:
             self.converged = True
 
     async def _announce(self) -> None:
-        """Bootstrap: register with every peer, adopt the longest chain
-        (ref: main.go:926-1024)."""
-        for pid in sorted(self.peers):
-            if pid == self.id:
-                continue
+        """Bootstrap: register with every peer concurrently, adopt the
+        longest chain seen (ref: main.go:926-1024 — the reference announces
+        serially; at N=100 a serial announce storm alone costs whole
+        rounds, so the fan-out runs as one gather)."""
+
+        async def one(pid: int) -> None:
             try:
                 cmeta, carrays = await self._call(
                     pid, "RegisterPeer",
@@ -1006,7 +1098,10 @@ class PeerAgent:
                     other.blocks = blocks
                     self.chain.maybe_adopt(other)
             except Exception:
-                continue
+                pass
+
+        await asyncio.gather(*(one(pid) for pid in sorted(self.peers)
+                               if pid != self.id))
 
     async def run(self) -> Dict:
         # resume from the newest on-disk snapshot, then let longest-chain
@@ -1049,6 +1144,7 @@ class PeerAgent:
                 await asyncio.to_thread(ckpt.save, self.chain, self.ckpt_dir)
                 await asyncio.to_thread(ckpt.prune, self.ckpt_dir, 3)
         dump = self.chain.dump()
+        self.pool.close()
         await self.server.stop()
         if self._events:
             self._events.close()
@@ -1080,7 +1176,9 @@ def main(argv=None) -> int:
     jax.config.update("jax_enable_x64", True)
     cfg = BiscottiConfig.from_args(ns)
     cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
-        cfg.num_nodes, cfg.num_verifiers, cfg.num_miners))
+        cfg.num_nodes, cfg.num_verifiers, cfg.num_miners,
+        random_sampling=cfg.random_sampling,
+        defense_is_krum=cfg.defense == Defense.KRUM))
     log_path = (os.path.join(ns.log_dir, f"events_{cfg.node_id}.jsonl")
                 if ns.log_dir else "")
     ckpt_dir = (os.path.join(ns.ckpt_dir, f"node_{cfg.node_id}")

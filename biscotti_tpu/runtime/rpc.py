@@ -1,19 +1,29 @@
-"""Asyncio RPC layer: per-call dial, per-call timeout, typed errors.
+"""Asyncio RPC layer: pooled multiplexed connections, per-call timeouts,
+typed errors.
 
 Mirrors the reference's transport semantics (SURVEY.md §2.1 row 2, §5.8):
-  * one TCP dial per call with a `select{reply, timeout}` guard
-    (ref: DistSys/main.go:1447-1489) — `call()` wraps the dial+roundtrip in
-    `asyncio.wait_for`
+  * per-call `select{reply, timeout}` guard (ref: DistSys/main.go:1447-1489)
+    — every call wraps its roundtrip in `asyncio.wait_for`
   * the callee can reply with a *stale* error that callers treat as a
     signal, not a failure (ref: DistSys/main.go:140,380-383 staleError)
   * dead peers surface as TimeoutError/ConnectionError so the membership
     layer can evict them (ref: main.go:1468-1487)
 
+Design departure from the reference, on purpose: the reference dials a
+fresh TCP connection for every RPC (`rpc.Dial` per call) — at N=100 full
+mesh that is thousands of handshakes per round and was a scale bottleneck.
+Here each peer keeps ONE persistent connection per (host, port), and
+concurrent calls multiplex over it with request-id correlation (`rid`);
+a timed-out call abandons its future while the connection stays usable
+(late replies to abandoned rids are dropped). Connection failure fails all
+in-flight calls on it and redials lazily on next use.
+
 Server side: one asyncio task per connection, frames dispatched to a single
 handler coroutine `handle(msg_type, meta, arrays) -> (meta, arrays)`.
 Handlers may block (e.g. a verifier parking a caller until the round's Krum
 resolves, ref: DistSys/krum.go:330-336) — each request runs as its own task
-so a parked call never stalls the connection's other requests.
+so a parked call never stalls the connection's other requests, and replies
+carry the request's rid so out-of-order completion is fine.
 """
 
 from __future__ import annotations
@@ -60,11 +70,19 @@ class RPCServer:
                                                   self.port)
 
     async def stop(self) -> None:
+        # cancel live connection handlers BEFORE wait_closed(): since 3.12
+        # wait_closed waits for every handler to finish, and handlers on
+        # persistent pooled connections run until the remote side closes —
+        # waiting first would deadlock two peers stopping simultaneously
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
         for t in list(self._conn_tasks):
             t.cancel()
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 5.0)
+            except asyncio.TimeoutError:
+                pass
 
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter) -> None:
@@ -116,13 +134,150 @@ class RPCServer:
                 pass
 
 
+class _Conn:
+    """One persistent multiplexed client connection."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.pending: Dict[int, asyncio.Future] = {}
+        self.next_rid = 1
+        self.write_lock = asyncio.Lock()
+        self.reader_task = asyncio.create_task(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                payload = await msgs.read_frame(self.reader)
+                try:
+                    _, rmeta, rarrays = msgs.decode(payload)
+                except msgs.CodecError:
+                    break  # garbled peer: tear the connection down
+                fut = self.pending.pop(rmeta.get("rid"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result((rmeta, rarrays))
+                # unknown rid: reply to an abandoned (timed-out) call — drop
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self._fail_all(ConnectionError("connection lost"))
+            self.writer.close()
+
+    def _fail_all(self, exc: Exception) -> None:
+        for fut in self.pending.values():
+            if not fut.done():
+                fut.set_exception(exc)
+                fut.exception()  # mark retrieved: abandoned callers are fine
+        self.pending.clear()
+
+    @property
+    def alive(self) -> bool:
+        return not self.reader_task.done()
+
+    async def _send(self, frame: bytes, timeout: float) -> None:
+        """Bounded write: a peer that stops draining (full receive buffer,
+        long GIL hold) must not wedge the write lock forever — on timeout
+        the connection is torn down so queued callers fail fast and the
+        next use redials."""
+        try:
+            async with self.write_lock:
+                self.writer.write(frame)
+                await asyncio.wait_for(self.writer.drain(), timeout)
+        except asyncio.TimeoutError:
+            self.close()
+            raise
+        except ConnectionError:
+            self.close()
+            raise
+
+    async def roundtrip(self, msg_type, meta, arrays, timeout):
+        rid = self.next_rid
+        self.next_rid += 1
+        fut = asyncio.get_running_loop().create_future()
+        self.pending[rid] = fut
+        meta2 = dict(meta or {})
+        meta2["rid"] = rid
+        frame = msgs.encode(msg_type, meta2, arrays)
+        deadline = asyncio.get_running_loop().time() + timeout
+        try:
+            await self._send(frame, timeout)
+            remaining = max(0.001, deadline - asyncio.get_running_loop().time())
+            return await asyncio.wait_for(fut, remaining)
+        finally:
+            self.pending.pop(rid, None)
+
+    def close(self) -> None:
+        self.reader_task.cancel()
+        self.writer.close()
+
+
+class Pool:
+    """Per-agent connection pool: one persistent connection per (host,
+    port), multiplexing concurrent calls (see module docstring)."""
+
+    def __init__(self):
+        self._conns: Dict[Tuple[str, int], _Conn] = {}
+        self._dialing: Dict[Tuple[str, int], asyncio.Task] = {}
+
+    async def _dial(self, key: Tuple[str, int]) -> _Conn:
+        reader, writer = await asyncio.open_connection(*key)
+        conn = _Conn(reader, writer)
+        self._conns[key] = conn
+        return conn
+
+    async def _get(self, host: str, port: int, timeout: float) -> _Conn:
+        """Concurrent callers to one peer SHARE a single in-flight dial
+        (shielded, so each caller's timeout cancels only its own wait) —
+        holding a lock across the dial would serialize N callers into
+        N × timeout worst-case latency against a dead peer."""
+        key = (host, port)
+        conn = self._conns.get(key)
+        if conn is not None and conn.alive:
+            return conn
+        task = self._dialing.get(key)
+        if task is None or task.done():
+            task = asyncio.ensure_future(self._dial(key))
+            self._dialing[key] = task
+        return await asyncio.wait_for(asyncio.shield(task), timeout)
+
+    async def call(self, host: str, port: int, msg_type: str,
+                   meta: Dict[str, Any] | None = None,
+                   arrays: Dict[str, np.ndarray] | None = None,
+                   timeout: float = 120.0):
+        conn = await self._get(host, port, timeout)
+        rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays, timeout)
+        if rmeta.get("error"):
+            if rmeta.get("stale"):
+                raise StaleError(rmeta["error"])
+            raise RPCError(rmeta["error"])
+        return rmeta, rarrays
+
+    async def post(self, host: str, port: int, frame: bytes,
+                   timeout: float = 120.0) -> None:
+        """Fire-and-forget a PRE-ENCODED frame (rid 0: any reply is dropped
+        by the reader). Lets a broadcast encode its payload once and write
+        the same bytes to every peer — at N=100 the per-peer re-encode of a
+        multi-MB block was the event loop's dominant cost."""
+        conn = await self._get(host, port, timeout)
+        await conn._send(frame, timeout)
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()
+        self._conns.clear()
+        for task in self._dialing.values():
+            task.cancel()
+        self._dialing.clear()
+
+
 async def call(host: str, port: int, msg_type: str,
                meta: Dict[str, Any] | None = None,
                arrays: Dict[str, np.ndarray] | None = None,
                timeout: float = 120.0) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
-    """Dial, send one request, await the reply, close. Raises
-    asyncio.TimeoutError / ConnectionError on dead peers, StaleError /
-    RPCError on remote-signalled failures."""
+    """One-shot convenience call (dial, request, close) for tools and
+    tests; the runtime uses a persistent `Pool`."""
 
     async def _roundtrip():
         reader, writer = await asyncio.open_connection(host, port)

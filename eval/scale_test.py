@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Scale harness — N-peer clusters (up to the reference's headline N=100)
+as one asyncio process over real TCP loopback, with the chain-equality
+oracle and measured s/iteration artifacts.
+
+The reference's scale evals boot 100 OS processes across an Azure fleet
+(ref: eval/eval_FedSys_scale/runEval.sh, azure/azure-run/runBiscotti.sh) —
+100 Python+JAX processes don't fit one box, but the peer agent is a pure
+asyncio state machine, so N agents share one process and one jit cache
+while still speaking real TCP RPC. Emits the reference's
+`iteration,error,timestamp` CSV shape (ref: eval_performance/parseLogs.py)
+plus a JSON summary with s/iter, directly comparable to
+BASELINE.md (Biscotti 38.2-42.0 s/iter, FedSys 7.1-9.1 s/iter @ 100 nodes).
+
+Usage:
+    python eval/scale_test.py --nodes 100 --dataset creditcard \
+        [--fedsys] [--secure-agg 1] [--noising 1] [--verification 1] \
+        [--iterations 3] [--out eval/results]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_cfgs(args):
+    from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+
+    timeouts = Timeouts().scaled(
+        args.nodes, args.num_verifiers, args.num_miners,
+        defense_is_krum=args.defense == "KRUM")
+    cfgs = []
+    for i in range(args.nodes):
+        cfgs.append(BiscottiConfig(
+            node_id=i, num_nodes=args.nodes, dataset=args.dataset,
+            base_port=args.base_port,
+            num_miners=args.num_miners, num_verifiers=args.num_verifiers,
+            num_noisers=args.num_noisers,
+            secure_agg=bool(args.secure_agg), noising=bool(args.noising),
+            verification=bool(args.verification),
+            fedsys=args.fedsys, defense=Defense(args.defense),
+            epsilon=args.epsilon, poison_fraction=args.poison,
+            max_iterations=args.iterations, convergence_error=0.0,
+            sample_percent=args.sample_percent, seed=args.seed,
+            timeouts=timeouts,
+        ))
+    return cfgs
+
+
+async def run_cluster(cfgs, log_dir=""):
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    agents = [
+        PeerAgent(c, log_path=os.path.join(log_dir, f"events_{c.node_id}.jsonl")
+                  if log_dir else "")
+        for c in cfgs
+    ]
+    t0 = time.time()
+    results = await asyncio.gather(*(a.run() for a in agents))
+    wall = time.time() - t0
+    return agents, results, wall
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--dataset", default="creditcard")
+    ap.add_argument("--base-port", type=int, default=26000)
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--fedsys", action="store_true")
+    ap.add_argument("--secure-agg", type=int, default=0)
+    ap.add_argument("--noising", type=int, default=0)
+    ap.add_argument("--verification", type=int, default=0)
+    ap.add_argument("--defense", default="KRUM")
+    ap.add_argument("--epsilon", type=float, default=1.0)
+    ap.add_argument("--poison", type=float, default=0.0)
+    ap.add_argument("--sample-percent", type=float, default=0.70)
+    ap.add_argument("--num-miners", type=int, default=3)
+    ap.add_argument("--num-verifiers", type=int, default=3)
+    ap.add_argument("--num-noisers", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--tag", default="")
+    ap.add_argument("--log-dir", default="")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for the in-process cluster; the "
+                         "default keeps the harness on host CPU even when "
+                         "a tunneled accelerator is visible (per-call "
+                         "tunnel latency × N peers swamps the measurement)")
+    args = ap.parse_args(argv)
+
+    os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    jax.config.update("jax_enable_x64", True)
+
+    cfgs = build_cfgs(args)
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+    agents, results, wall = asyncio.run(run_cluster(cfgs, args.log_dir))
+
+    dumps = [r["chain_dump"] for r in results]
+    equal = all(d == dumps[0] for d in dumps)
+    n_blocks = len(dumps[0].splitlines()) - 1  # minus genesis
+    nonempty = sum(1 for line in dumps[0].splitlines()[1:]
+                   if "ndeltas=0" not in line)
+
+    # s/iter from node 0's round log timestamps (the reference's method:
+    # wall-clock deltas between per-iteration log lines)
+    rows = [tuple(x.split(",")) for x in results[0]["logs"]]
+    if len(rows) >= 2:
+        ts = [float(r[2]) for r in rows]
+        s_per_iter = (ts[-1] - ts[0]) / (len(ts) - 1)
+    else:
+        s_per_iter = wall / max(1, n_blocks)
+
+    mode = "fedsys" if args.fedsys else "biscotti"
+    summary = {
+        "mode": mode, "nodes": args.nodes, "dataset": args.dataset,
+        # all N peers share this host: s/iter here charges every peer's
+        # compute+crypto to os.cpu_count() cores, where the reference's
+        # fleet numbers (BASELINE.md) spread 100 nodes over ~20 multi-core
+        # VMs — normalize before comparing
+        "host_cores": os.cpu_count(),
+        "secure_agg": bool(args.secure_agg), "noising": bool(args.noising),
+        "verification": bool(args.verification),
+        "iterations_run": n_blocks, "nonempty_blocks": nonempty,
+        "chains_equal": equal, "wall_s": round(wall, 2),
+        "s_per_iter": round(s_per_iter, 3),
+        "final_error": results[0]["final_error"],
+        "data_note": "synthetic Gaussian shards (zero-egress env); "
+                     "errors not comparable to real-data curves",
+    }
+    print(json.dumps(summary))
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        tag = args.tag or f"{mode}_{args.dataset}_{args.nodes}"
+        with open(os.path.join(args.out, f"scale_{tag}.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+        with open(os.path.join(args.out, f"scale_{tag}.csv"), "w") as f:
+            for r in results[0]["logs"]:
+                f.write(r + "\n")
+    if not equal:
+        print("[scale] FAIL: chain-equality oracle violated", file=sys.stderr)
+        return 1
+    if nonempty == 0:
+        print("[scale] FAIL: no non-empty blocks minted", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -218,6 +218,100 @@ inline ge ge_double(const ge &p) {
   return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
 }
 
+// a^e mod p for a little-endian 32-byte exponent (vartime; public data).
+fe fe_pow(const fe &a, const uint8_t e[32]) {
+  fe r = fe_one();
+  for (int i = 255; i >= 0; i--) {
+    r = fe_sq(r);
+    if ((e[i >> 3] >> (i & 7)) & 1) r = fe_mul(r, a);
+  }
+  return r;
+}
+
+inline bool fe_eq(const fe &a, const fe &b) {
+  uint8_t ab[32], bb[32];
+  fe_tobytes(ab, a);
+  fe_tobytes(bb, b);
+  return memcmp(ab, bb, 32) == 0;
+}
+
+inline bool fe_is_zero(const fe &a) {
+  uint8_t ab[32];
+  static const uint8_t zero[32] = {0};
+  fe_tobytes(ab, a);
+  return memcmp(ab, zero, 32) == 0;
+}
+
+// curve constant d = -121665/121666 and sqrt(-1), derived once at startup
+// from the D2 (= 2d) constant above so no second hand-packed literal can
+// drift out of sync with it.
+struct Consts {
+  fe d;
+  fe sqrt_m1;
+  Consts() {
+    fe two = fe_add(fe_one(), fe_one());
+    d = fe_mul(D2, fe_invert(two));
+    // sqrt(-1) = 2^((p-1)/4); (p-1)/4 = 2^253 - 5
+    uint8_t e[32];
+    memset(e, 0xFF, 32);
+    e[31] = 0x1F;
+    e[0] = 0xFB;  // 2^253 - 5 low byte: 0x100 - 5 = 0xFB
+    sqrt_m1 = fe_pow(two, e);
+  }
+};
+const Consts &consts() {
+  static Consts c;
+  return c;
+}
+
+// RFC 8032 §5.1.3 decompression: 32-byte compressed -> extended coords.
+// Returns false for a non-canonical y or an off-curve encoding.
+bool ge_decompress(const uint8_t in[32], ge &out) {
+  // canonical y check: y (with sign bit cleared) must be < p
+  uint8_t yb[32];
+  memcpy(yb, in, 32);
+  int sign = yb[31] >> 7;
+  yb[31] &= 0x7F;
+  static const uint8_t pbytes[32] = {
+      0xED, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F};
+  for (int i = 31; i >= 0; i--) {
+    if (yb[i] < pbytes[i]) break;
+    if (yb[i] > pbytes[i]) return false;
+    if (i == 0) return false;  // y == p
+  }
+  fe y = fe_frombytes(yb);
+  fe y2 = fe_sq(y);
+  fe u = fe_sub(y2, fe_one());           // y^2 - 1
+  fe v = fe_add(fe_mul(consts().d, y2), fe_one());  // d*y^2 + 1
+  // candidate x = u * v^3 * (u * v^7)^((p-5)/8); (p-5)/8 = 2^252 - 3
+  uint8_t e[32];
+  memset(e, 0xFF, 32);
+  e[31] = 0x0F;
+  e[0] = 0xFD;  // 2^252 - 3 low byte
+  fe v3 = fe_mul(fe_sq(v), v);
+  fe v7 = fe_mul(fe_sq(v3), v);
+  fe x = fe_mul(fe_mul(u, v3), fe_pow(fe_mul(u, v7), e));
+  fe vx2 = fe_mul(v, fe_sq(x));
+  if (fe_eq(vx2, u)) {
+    // ok
+  } else if (fe_eq(vx2, fe_sub(fe_zero(), u))) {
+    x = fe_mul(x, consts().sqrt_m1);
+  } else {
+    return false;
+  }
+  if (fe_is_zero(x) && sign) return false;
+  uint8_t xb[32];
+  fe_tobytes(xb, x);
+  if ((xb[0] & 1) != sign) x = fe_sub(fe_zero(), x);
+  out.X = x;
+  out.Y = y;
+  out.Z = fe_one();
+  out.T = fe_mul(x, y);
+  return true;
+}
+
 }  // namespace
 
 // ------------------------------------------------------------------- C ABI
@@ -322,6 +416,24 @@ int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
 int ed25519_scalarmult(const uint8_t *scalar, const uint8_t *point,
                        uint8_t *out) {
   return ed25519_msm(scalar, point, 1, out);
+}
+
+// Batch point decompression: n×32-byte compressed encodings →
+// n×128-byte extended (X,Y,Z,T) buffers, the input format of ed25519_msm.
+// Returns 0 when every point decodes, else 1+index of the first invalid
+// encoding. This is the miner-side hot spot of VSS share verification —
+// one decompression per committed coefficient (d per update), which in
+// pure Python (a sqrt mod p each) dwarfed the MSM itself.
+int ed25519_decompress_batch(const uint8_t *comp, size_t n, uint8_t *out) {
+  for (size_t i = 0; i < n; i++) {
+    ge p;
+    if (!ge_decompress(comp + i * 32, p)) return (int)(i + 1);
+    fe_tobytes(out + i * 128, p.X);
+    fe_tobytes(out + i * 128 + 32, p.Y);
+    fe_tobytes(out + i * 128 + 64, p.Z);
+    fe_tobytes(out + i * 128 + 96, p.T);
+  }
+  return 0;
 }
 
 // Batch Pedersen commit: out[i] = a[i]·G + b[i]·H for i < n, affine (x,y)
