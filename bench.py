@@ -1,69 +1,226 @@
 #!/usr/bin/env python
-"""Headline benchmark: wall-clock per training iteration, 100-peer MNIST
-softmax with Krum verification and DP noising — the reference's flagship
-configuration (BASELINE.md row 1: 38.2–42.0 s/iteration on 100 Azure
-VMs-worth of CPU processes; north star ≲4 s/iteration).
+"""Headline benchmark — crypto-inclusive wall-clock per training iteration
+across the five BASELINE.json configs.
 
-One full iteration here = every contributor's local SGD step + DP noise +
-Krum filtering over the round's updates + aggregation + stake update +
-convergence metric, all in one jitted XLA program on the TPU.
+One Biscotti iteration's critical path (deployment model: one peer per
+TPU host, as the reference runs one peer per process across VMs) is:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline = reference_seconds / our_seconds (higher is better; ≥10 is the
-north-star).
+    device round   all peers' SGD + DP noise + Krum + aggregation as ONE
+                   vmapped XLA program on the chip (parallel/sim.py)
+  + worker crypto  ONE peer's quantize → Pedersen-VSS chunk commitments →
+                   blinding rows → int64 Shamir shares (host C++/CPU;
+                   peers run this in parallel in deployment, so one
+                   peer's cost is the critical-path term)
+  + miner crypto   the busiest miner's intake: batched VSS verification of
+                   every accepted contributor's share slice (× NUM_SAMPLES/2,
+                   the mint trigger, ref: main.go:345-363)
+  + recovery       leader's Vandermonde least-squares recovery of the
+                   aggregate (CPU-pinned int64/f64 path, see
+                   ops/secretshare.py docstring: TPUs have no exact s64
+                   matmul — a deliberate, validated host fallback)
+
+Round 1's bench measured only the device round and reported 32,965× —
+real, but it omitted exactly the costs that dominated the reference's
+38.2 s/iter (the O(d) EC work per update, SURVEY §7.3). This bench times
+every component and also validates the int64 share pipeline end-to-end
+(shares → aggregate → recover == Σ quantized) on this host.
+
+Disclosure: datasets are synthetic Gaussian shards (zero-egress build
+environment) with reference dimensions — error columns are NOT comparable
+to the reference's real-MNIST curves; timing is, since shapes match.
+vs_baseline compares against the reference's published fleet numbers
+(BASELINE.md: 38.2 s/iter, 100 nodes over ~20 multi-VM CPU cores);
+configs the reference never published numbers for carry vs_baseline null.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...,
+"configs": {per-config rows}}.
 """
 
 import json
 import sys
 import time
 
-BASELINE_S_PER_ITER = 38.2  # BASELINE.md: Biscotti wall-clock/iteration, low end
+BASELINE_MNIST_S_PER_ITER = 38.2  # BASELINE.md row 1, low end
+
+
+def _timeit(fn, warm=1, iters=3):
+    for _ in range(warm):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def _progress(msg):
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def bench_config(name, cfg, device_iters=10):
+    import jax
+    import numpy as np
+
+    from biscotti_tpu.crypto import commitments as cm
+    from biscotti_tpu.ops import secretshare as ss
+    from biscotti_tpu.parallel.sim import Simulator
+
+    _progress(f"{name}: building simulator")
+    sim = Simulator(cfg)
+    w, stake = sim.init_state()
+    _progress(f"{name}: compiling device round")
+
+    # --- device round: all peers' SGD + noise + defense + aggregation
+    for it in range(2):
+        w, stake, mask, err = sim.round_step(w, stake, it)
+    jax.block_until_ready(w)
+    t0 = time.perf_counter()
+    for it in range(2, 2 + device_iters):
+        w, stake, mask, err = sim.round_step(w, stake, it)
+    jax.block_until_ready(w)
+    device_s = (time.perf_counter() - t0) / device_iters
+    _progress(f"{name}: device round {device_s:.4f}s; measuring host crypto")
+    accepted = int(np.asarray(mask).sum())
+
+    d = sim.num_params
+    k = cfg.poly_size
+    total_shares = cfg.total_shares
+    per_miner = cfg.shares_per_miner
+    row = {
+        "dataset": cfg.dataset, "nodes": cfg.num_nodes, "params": d,
+        "defense": cfg.defense.value, "secure_agg": cfg.secure_agg,
+        "noising": cfg.noising, "poison": cfg.poison_fraction,
+        "device_round_s": round(device_s, 6),
+        "accepted_per_round": accepted,
+        "final_error": round(float(err), 4),
+    }
+
+    # --- host crypto, measured per-op then composed into the critical path
+    delta = np.asarray(w, np.float64)  # representative d-vector
+    scale = 10.0 ** cfg.precision
+    q = np.trunc(delta * scale).astype(np.int64)
+    if cfg.secure_agg:
+        c = ss.num_chunks(d, k)
+        padded = np.zeros(c * k, np.int64)
+        padded[:d] = q
+        chunks = padded.reshape(c, k)
+        xs_all = [i - ss.SHARE_OFFSET for i in range(total_shares)]
+
+        comms = br = sh = None
+
+        def worker():
+            nonlocal comms, br, sh
+            comms, blinds = cm.vss_commit_chunks(chunks, b"bench-seed" * 3,
+                                                 b"ctx")
+            br = cm.vss_blind_rows(blinds, xs_all)
+            sh = np.asarray(ss.make_shares(q, k, total_shares))
+
+        worker_s = _timeit(worker, warm=1, iters=2)
+        # miner cost = ONE batched RLC+MSM over the whole round intake
+        # (vss_verify_multi), measured at the mint-trigger intake size
+        sl = slice(0, per_miner)
+        intake = max(1, cfg.num_samples // 2)
+        instances = [(comms, xs_all[sl], sh[sl], br[sl])] * intake
+        miner_s = _timeit(lambda: cm.vss_verify_multi(instances),
+                          warm=1, iters=2)
+
+        # recovery (+ correctness: the int64 pipeline round-trips exactly)
+        agg = np.asarray(ss.aggregate_shares(sh[None].repeat(3, axis=0)))
+        xs_arr = np.asarray(ss.share_xs(total_shares))
+
+        def recover():
+            return np.asarray(ss.recover_update(agg, xs_arr, d, k,
+                                                cfg.precision))
+
+        recover_s = _timeit(recover, warm=1, iters=2)
+        rec = recover()
+        roundtrip_ok = bool(np.allclose(rec, 3 * q / scale, atol=1e-9))
+        row.update({
+            "worker_crypto_s": round(worker_s, 4),
+            "miner_intake": intake,
+            "miner_crypto_s": round(miner_s, 4),
+            "recovery_s": round(recover_s, 4),
+            "share_pipeline_roundtrip_ok": roundtrip_ok,
+        })
+        total = device_s + worker_s + miner_s + recover_s
+    else:
+        # plain mode: hash commitment + miner recompute — negligible but
+        # measured anyway
+        import hashlib
+
+        commit_s = _timeit(lambda: hashlib.sha256(q.tobytes()).digest(),
+                           warm=1, iters=5)
+        row.update({"worker_crypto_s": round(commit_s, 6),
+                    "miner_crypto_s": round(commit_s * cfg.num_samples, 6)})
+        total = device_s + commit_s * (1 + cfg.num_samples)
+
+    row["round_total_s"] = round(total, 4)
+    _progress(f"{name}: total {total:.3f}s/iter")
+    return name, row, total
 
 
 def main():
     import jax
 
     from biscotti_tpu.config import BiscottiConfig, Defense
-    from biscotti_tpu.parallel.sim import Simulator
 
-    cfg = BiscottiConfig(
-        dataset="mnist",
-        num_nodes=100,
-        batch_size=10,  # ref batch size (client_obj __main__, honest.go)
-        epsilon=1.0,
-        noising=True,
-        verification=True,
-        defense=Defense.KRUM,
-        sample_percent=0.70,
-        num_verifiers=3,
-        num_miners=3,
-        seed=0,
-    )
-    sim = Simulator(cfg)
-    w, stake = sim.init_state()
+    jax.config.update("jax_enable_x64", True)
 
-    # warm-up: compile + first dispatch
-    for it in range(3):
-        w, stake, mask, err = sim.round_step(w, stake, it)
-    jax.block_until_ready(w)
+    base = dict(batch_size=10, epsilon=1.0, sample_percent=0.70,
+                num_verifiers=3, num_miners=3, num_noisers=2, seed=0)
+    configs = [
+        # BASELINE.json "configs" rows, in order
+        ("creditcard_10", BiscottiConfig(
+            dataset="creditcard", num_nodes=10, secure_agg=True,
+            noising=True, verification=True, defense=Defense.KRUM, **base)),
+        ("mnist_100_clean", BiscottiConfig(
+            dataset="mnist", num_nodes=100, secure_agg=True, noising=False,
+            verification=True, defense=Defense.KRUM, **base)),
+        ("mnist_100_poison30_krum", BiscottiConfig(
+            dataset="mnist", num_nodes=100, secure_agg=True, noising=True,
+            verification=True, defense=Defense.KRUM, poison_fraction=0.30,
+            **base)),
+        ("mnist_100_dp_eps1", BiscottiConfig(
+            dataset="mnist", num_nodes=100, secure_agg=True, noising=True,
+            verification=True, defense=Defense.KRUM, **base)),
+        ("cifar_lenet_100_krum_secagg", BiscottiConfig(
+            dataset="cifar", num_nodes=100, secure_agg=True, noising=False,
+            verification=True, defense=Defense.KRUM, **base)),
+    ]
 
-    iters = 30
-    t0 = time.perf_counter()
-    for it in range(3, 3 + iters):
-        w, stake, mask, err = sim.round_step(w, stake, it)
-    jax.block_until_ready(w)
-    dt = (time.perf_counter() - t0) / iters
+    rows = {}
+    headline_total = None
+    for name, cfg in configs:
+        iters = 5 if cfg.dataset == "cifar" else 10
+        try:
+            name, row, total = bench_config(name, cfg, device_iters=iters)
+        except Exception as e:  # a config must never sink the whole bench
+            rows[name] = {"error": f"{type(e).__name__}: {e}"}
+            continue
+        if name.startswith("mnist"):
+            row["vs_baseline"] = round(BASELINE_MNIST_S_PER_ITER / total, 2)
+        else:
+            row["vs_baseline"] = None  # reference published no number
+        rows[name] = row
+        if name == "mnist_100_dp_eps1":
+            headline_total = total
 
     out = {
-        "metric": "wall-clock/iteration, 100-peer MNIST softmax + Krum + DP (ref: 38.2s)",
-        "value": round(dt, 6),
+        "metric": ("crypto-inclusive wall-clock/iteration, 100-peer MNIST "
+                   "softmax + Krum + DP eps=1.0 + secure-agg "
+                   "(device round + VSS commit/share + miner verify + "
+                   "recovery; ref fleet: 38.2 s/iter)"),
+        "value": round(headline_total, 4) if headline_total else None,
         "unit": "s/iter",
-        "vs_baseline": round(BASELINE_S_PER_ITER / dt, 2),
-        "final_error": round(float(err), 4),
-        "accepted_per_round": int(mask.sum()),
+        "vs_baseline": (round(BASELINE_MNIST_S_PER_ITER / headline_total, 2)
+                        if headline_total else None),
         "device": str(jax.devices()[0]),
+        "data_note": ("synthetic Gaussian shards at reference dimensions "
+                      "(zero-egress env): timings comparable, error columns "
+                      "not"),
+        "configs": rows,
     }
     print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":

@@ -75,8 +75,8 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
-        lib.ed25519_decompress_batch.restype = ctypes.c_int
-        lib.ed25519_decompress_batch.argtypes = [
+        lib.ed25519_load_xy_batch.restype = ctypes.c_int
+        lib.ed25519_load_xy_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
         if not _selfcheck(lib):
@@ -154,23 +154,24 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     return (x, y, 1, (x * y) % ed.P)
 
 
-def decompress_batch(comp: bytes, n: int) -> Optional[bytes]:
-    """n×32B compressed points → n×128B extended buffer (ed25519_msm's
-    input format), or None if any encoding is invalid/off-curve."""
+def load_xy_batch(xy: bytes, n: int) -> Optional[bytes]:
+    """n×64B affine (x,y) pairs → n×128B extended buffer, with canonicity
+    and on-curve validation (NOT subgroup — fold cofactor 8 into scalars).
+    None if any point is invalid."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
-    if len(comp) != 32 * n:
-        raise ValueError("compressed buffer length mismatch")
+    if len(xy) != 64 * n:
+        raise ValueError("xy buffer length mismatch")
     out = ctypes.create_string_buffer(128 * n)
-    rc = lib.ed25519_decompress_batch(comp, n, out)
+    rc = lib.ed25519_load_xy_batch(xy, n, out)
     if rc != 0:
         return None
     return out.raw
 
 
 def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
-    """MSM over an already-decompressed 128B/point buffer (from
-    decompress_batch) — skips the per-point python int marshalling."""
+    """MSM over an already-validated 128B/point buffer (from
+    load_xy_batch) — skips the per-point python int marshalling."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
     if len(points_buf) != 128 * n or len(scalars) != n:
@@ -185,16 +186,18 @@ def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
     return (x, y, 1, (x * y) % ed.P)
 
 
-def batch_commit(a: Sequence[int], b: Sequence[int]) -> List[bytes]:
-    """[aᵢ·G + bᵢ·H] compressed — worker-side VSS coefficient commitments
-    (byte-comb fixed-base path in C++)."""
+def batch_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
+    """[aᵢ·G + bᵢ·H] as a packed n×64B affine (x,y) buffer — worker-side
+    VSS coefficient commitments (byte-comb fixed-base path in C++). The
+    affine wire format skips both compression here and the sqrt-heavy
+    decompression at every verifier."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
     if len(a) != len(b):
         raise ValueError("scalar length mismatch")
     n = len(a)
     if n == 0:
-        return []
+        return b""
     abuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in a)
     bbuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in b)
     from biscotti_tpu.crypto.commitments import H_POINT
@@ -204,9 +207,6 @@ def batch_commit(a: Sequence[int], b: Sequence[int]) -> List[bytes]:
                                   _point_bytes(H_POINT), n, out)
     if rc != 0:
         raise RuntimeError(f"native batch_commit failed: {rc}")
-    res: List[bytes] = []
-    for i in range(n):
-        x = int.from_bytes(out.raw[64 * i: 64 * i + 32], "little")
-        y = int.from_bytes(out.raw[64 * i + 32: 64 * i + 64], "little")
-        res.append(((y | ((x & 1) << 255)).to_bytes(32, "little")))
-    return res
+    return out.raw
+
+

@@ -162,6 +162,55 @@ def schnorr_sign(seed: bytes, message: bytes) -> bytes:
     return r + s.to_bytes(32, "little")
 
 
+def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
+    """Verify MANY (public, message, signature) triples in one shot via a
+    random linear combination: Σγᵢ·sᵢ·B == Σγᵢ·Rᵢ + Σγᵢ·cᵢ·Yᵢ, one MSM
+    total. With 128-bit random γ a single bad signature survives with
+    probability 2⁻¹²⁸; on failure, fall back per-item to identify it.
+    This is what makes verifier-quorum checks on whole BLOCKS (and on
+    candidate chains during adoption) affordable — one group equation per
+    block instead of one per signature."""
+    import os as _os
+
+    if not items:
+        return True
+    scalars: List[int] = []
+    points: List[ed.Point] = []
+    s_tot = 0
+    for pub, msg, sig in items:
+        if len(sig) != 64:
+            return False
+        r_pt = ed.point_decompress(sig[:32])
+        y_pt = _pub_point(pub)
+        if r_pt is None or y_pt is None:
+            return False
+        s = int.from_bytes(sig[32:], "little")
+        if s >= _Q:
+            return False
+        c = int.from_bytes(
+            hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % _Q
+        g = int.from_bytes(_os.urandom(16), "little") | 1
+        s_tot += g * s
+        scalars.append(g)
+        points.append(r_pt)
+        scalars.append((g * c) % _Q)
+        points.append(y_pt)
+    lhs = ed.base_mult(s_tot % _Q)
+    rhs = msm(scalars, points)
+    return ed.point_equal(lhs, rhs)
+
+
+# public-key decompression cache: node identities are long-lived and every
+# block verification touches the same few committee keys
+_pub_cache: dict = {}
+
+
+def _pub_point(pub: bytes) -> Optional[ed.Point]:
+    if pub not in _pub_cache:
+        _pub_cache[pub] = ed.point_decompress(pub)
+    return _pub_cache[pub]
+
+
 def schnorr_verify(public: bytes, message: bytes, signature: bytes) -> bool:
     """(ref: kyber.go:898-925)."""
     if len(signature) != 64:
@@ -256,10 +305,17 @@ def eval_poly(coeffs: Sequence[int], x: int) -> int:
 #
 # The protocol-facing layer: one VSS instance per polynomial chunk of the
 # quantized update, flattened to fixed-shape byte tensors so the runtime
-# codec can ship them (messages.py allows uint8 arrays). A miner receiving
-# its share-row slice verifies ALL (row, chunk) pairs in ONE batched check —
-# a random linear combination collapsing to a single d-point MSM — instead
-# of the reference's per-share pairing loop (ref: kyber.go:650-673).
+# codec can ship them (messages.py allows uint8 arrays). Commitment points
+# travel as AFFINE (x, y) pairs (64B), not compressed: loading one costs an
+# on-curve check (~7 field mults) instead of a sqrt mod p (~255 squarings),
+# and the verifier is the hot side. Subgroup membership is not checked —
+# every verification scalar is multiplied by the cofactor 8, which kills
+# any small-order component a malicious committer could smuggle in.
+#
+# A miner verifies ALL (worker, row, chunk) triples of its round intake in
+# ONE batched check — a random linear combination collapsing to a single
+# MSM (ref: the reference instead runs a bn256 pairing per share,
+# kyber.go:650-673). On failure, per-worker fallback identifies the cheat.
 
 
 def vss_commit_chunks(chunks: np.ndarray, seed: bytes,
@@ -267,9 +323,9 @@ def vss_commit_chunks(chunks: np.ndarray, seed: bytes,
     """Commit every chunk's coefficients.
 
     chunks: [C, k] int64 (ss.to_chunks output). Returns
-    (commitments uint8 [C, k, 32], blind coefficients [C][k] ints in Z_q).
-    The hot spot is 2·C·k scalar mults; the native batch-commit path in
-    `native/` takes it when built."""
+    (commitments uint8 [C, k, 64] affine (x,y) LE pairs, blind coefficients
+    [C][k] ints in Z_q). The hot spot is 2·C·k fixed-base mults; the native
+    byte-comb path in `native/` takes it when built."""
     c_chunks, k = chunks.shape
     blinds: List[List[int]] = []
     flat_a: List[int] = []
@@ -286,26 +342,40 @@ def vss_commit_chunks(chunks: np.ndarray, seed: bytes,
         blinds.append(row)
         flat_a.extend(int(v) for v in chunks[ci])
         flat_b.extend(row)
-    comms = batch_pedersen_commit(flat_a, flat_b)
-    out = np.frombuffer(b"".join(comms), dtype=np.uint8)
-    return out.reshape(c_chunks, k, 32).copy(), blinds
+    raw = batch_pedersen_commit_xy(flat_a, flat_b)
+    out = np.frombuffer(raw, dtype=np.uint8)
+    return out.reshape(c_chunks, k, 64).copy(), blinds
 
 
-def batch_pedersen_commit(a: Sequence[int], b: Sequence[int]) -> List[bytes]:
-    """[aᵢ·G + bᵢ·H] compressed, native fast path when available."""
+def batch_pedersen_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
+    """[aᵢ·G + bᵢ·H] as packed 64B affine pairs, native fast path when
+    available."""
     try:
         from biscotti_tpu.crypto import _native
 
         if _native.available():
-            return _native.batch_commit(a, b)
+            return _native.batch_commit_xy(a, b)
     except ImportError:
         pass
-    return [
-        ed.point_compress(
-            ed.point_add(ed.base_mult(_scalar(int(ai))),
-                         ed.scalar_mult(_scalar(int(bi)), H_POINT)))
-        for ai, bi in zip(a, b)
-    ]
+    out = bytearray()
+    for ai, bi in zip(a, b):
+        p = ed.point_add(ed.base_mult(_scalar(int(ai))),
+                         ed.scalar_mult(_scalar(int(bi)), H_POINT))
+        x, y = ed.to_affine(p)
+        out += x.to_bytes(32, "little") + y.to_bytes(32, "little")
+    return bytes(out)
+
+
+def _xy_to_point(buf: bytes) -> Optional[ed.Point]:
+    """Parse + validate one 64B affine pair (python fallback for the native
+    batch loader): canonical coords and on-curve, subgroup NOT checked."""
+    x = int.from_bytes(buf[:32], "little")
+    y = int.from_bytes(buf[32:64], "little")
+    if x >= ed.P or y >= ed.P:
+        return None
+    if (y * y - x * x - 1 - ed.D * x * x * y * y) % ed.P != 0:
+        return None
+    return (x, y, 1, (x * y) % ed.P)
 
 
 def vss_digest(comms: np.ndarray) -> bytes:
@@ -338,84 +408,96 @@ def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
     return out
 
 
-def vss_verify_rows(comms: np.ndarray, xs: Sequence[int],
-                    share_rows: np.ndarray, blind_rows: np.ndarray,
-                    entropy: Optional[bytes] = None) -> bool:
-    """Batched share verification: accept iff every (row r, chunk c) pair
-    satisfies s_rc·G + t_rc·H == Σⱼ x_r^j·C_cj.
+def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
+                                               np.ndarray, np.ndarray]],
+                     entropy: Optional[bytes] = None) -> bool:
+    """Batched share verification over MANY updates at once.
 
-    Soundness: with γ_rc random 128-bit, a single forged share passes with
-    probability 2⁻¹²⁸. One MSM over C·k points regardless of row count."""
+    instances: [(comms [C,k,64], xs, share_rows [S,C], blind_rows
+    [S,C,32]), ...]. Accepts iff every (instance w, row r, chunk c) triple
+    satisfies s·G + t·H == Σⱼ x_r^j·C_cj — checked as one random linear
+    combination collapsing to a SINGLE MSM over all instances' points, so
+    a miner verifies its whole round intake in one call. Soundness: γ
+    random 128-bit odd per triple ⇒ a forged share survives with
+    probability 2⁻¹²⁸; all scalars carry the cofactor 8, so small-order
+    point components cannot help a cheater. On False, call per-instance to
+    identify the offender."""
     import os as _os
 
-    if comms.ndim != 3 or comms.shape[2] != 32:
-        return False
-    c_chunks, k, _ = comms.shape
-    rows = np.asarray(share_rows)
-    if rows.shape != (len(xs), c_chunks) or blind_rows.shape != (len(xs), c_chunks, 32):
-        return False
-    entropy = entropy if entropy is not None else _os.urandom(16 * rows.size)
-    if len(entropy) < 16 * rows.size:
+    total_cells = 0
+    for comms, xs, rows, blind_rows in instances:
+        if comms.ndim != 3 or comms.shape[2] != 64:
+            return False
+        c_chunks = comms.shape[0]
+        if (np.asarray(rows).shape != (len(xs), c_chunks)
+                or blind_rows.shape != (len(xs), c_chunks, 32)):
+            return False
+        total_cells += len(xs) * c_chunks
+    if total_cells == 0:
+        return True
+    entropy = entropy if entropy is not None else _os.urandom(16 * total_cells)
+    if len(entropy) < 16 * total_cells:
         return False
 
-    # decompress commitment points once (refuse invalid encodings); the
-    # native batch path matters — at d=7,850 pure-python decompression (a
-    # sqrt mod p per point) costs more than the MSM itself
-    comm_bytes = np.ascontiguousarray(comms).tobytes()
-    pts: List[ed.Point] = []
-    pts_buf: Optional[bytes] = None
     try:
         from biscotti_tpu.crypto import _native
 
-        if _native.available():
-            pts_buf = _native.decompress_batch(comm_bytes, c_chunks * k)
-            if pts_buf is None:
-                return False
+        native = _native if _native.available() else None
     except ImportError:
-        pass
-    if pts_buf is None:
-        for i in range(c_chunks * k):
-            p = ed.point_decompress(comm_bytes[32 * i: 32 * i + 32])
-            if p is None:
-                return False
-            pts.append(p)
+        native = None
 
-    gammas = [
-        int.from_bytes(entropy[16 * i: 16 * (i + 1)], "little") | 1
-        for i in range(rows.size)
-    ]
-    # All accumulation runs over plain (signed) python ints with a single
-    # mod-q reduction per accumulator at the end: x is small (|x| ≤ S), so
-    # g·xʲ stays ≲ 2¹⁷³ and full-width modmuls — the hot cost at mnist
-    # scale — are avoided entirely.
     s_tot = 0
     t_tot = 0
-    coeff = [0] * (c_chunks * k)  # accumulated scalar per commitment point
+    all_scalars: List[int] = []
+    all_pts: List[ed.Point] = []
+    all_bufs: List[bytes] = []
     gi = 0
-    blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
-    for r, x in enumerate(xs):
-        xi = int(x)
-        for ci in range(c_chunks):
-            g = gammas[gi]
-            gi += 1
-            s_tot += g * int(rows[r, ci])
-            off = 32 * (r * c_chunks + ci)
-            t_val = int.from_bytes(blind_bytes[off: off + 32], "little")
-            if t_val >= _Q:
+    for comms, xs, rows, blind_rows in instances:
+        c_chunks, k, _ = comms.shape
+        comm_bytes = np.ascontiguousarray(comms).tobytes()
+        if native is not None:
+            buf = native.load_xy_batch(comm_bytes, c_chunks * k)
+            if buf is None:
                 return False
-            t_tot += g * t_val
-            xj = g
-            base = ci * k
-            for j in range(k):
-                coeff[base + j] += xj
-                xj *= xi
+            all_bufs.append(buf)
+        else:
+            for i in range(c_chunks * k):
+                p = _xy_to_point(comm_bytes[64 * i: 64 * i + 64])
+                if p is None:
+                    return False
+                all_pts.append(p)
+        # RLC accumulation over plain (signed) python ints, one mod-q
+        # reduction per accumulator at the end: x is small (|x| ≤ S), so
+        # γ·xʲ stays ≲ 2¹⁷⁶ and full-width modmuls are avoided entirely
+        rows = np.asarray(rows)
+        coeff = [0] * (c_chunks * k)
+        blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
+        for r, x in enumerate(xs):
+            xi = int(x)
+            for ci in range(c_chunks):
+                g = (int.from_bytes(entropy[16 * gi: 16 * (gi + 1)],
+                                    "little") | 1) * 8  # cofactor-folded
+                gi += 1
+                s_tot += g * int(rows[r, ci])
+                off = 32 * (r * c_chunks + ci)
+                t_val = int.from_bytes(blind_bytes[off: off + 32], "little")
+                if t_val >= _Q:
+                    return False
+                t_tot += g * t_val
+                xj = g
+                base = ci * k
+                for j in range(k):
+                    coeff[base + j] += xj
+                    xj *= xi
+        all_scalars.extend(v % _Q for v in coeff)
+
     lhs = ed.point_add(ed.base_mult(s_tot % _Q),
                        ed.scalar_mult(t_tot % _Q, H_POINT))
-    scalars = [v % _Q for v in coeff]
-    if pts_buf is not None:
-        from biscotti_tpu.crypto import _native
-
-        rhs = _native.msm_raw(scalars, pts_buf, c_chunks * k)
+    if native is not None:
+        rhs = native.msm_raw(all_scalars, b"".join(all_bufs),
+                             len(all_scalars))
     else:
-        rhs = msm(scalars, pts)
+        rhs = msm(all_scalars, all_pts)
     return ed.point_equal(lhs, rhs)
+
+

@@ -90,11 +90,15 @@ def is_identity(p: Point) -> bool:
     return point_equal(p, IDENTITY)
 
 
-def point_compress(p: Point) -> bytes:
+def to_affine(p: Point) -> tuple:
+    """(x, y) affine coordinates."""
     x, y, z, _ = p
     zinv = pow(z, P - 2, P)
-    xa = (x * zinv) % P
-    ya = (y * zinv) % P
+    return (x * zinv) % P, (y * zinv) % P
+
+
+def point_compress(p: Point) -> bytes:
+    xa, ya = to_affine(p)
     return ((ya | ((xa & 1) << 255)).to_bytes(32, "little"))
 
 

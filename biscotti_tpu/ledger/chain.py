@@ -109,31 +109,47 @@ class Blockchain:
         return False
 
     def maybe_adopt(self, other: "Blockchain") -> bool:
-        """Longest-chain adoption on (re)join (ref: main.go:1001-1013).
+        """Fork-choice adoption on (re)join (ref: main.go:1001-1013 adopts
+        any longer chain blindly).
 
-        Guards against Byzantine suppliers: the candidate must (a) verify
-        structurally, (b) extend this chain's existing *settled* prefix — a
-        longer but divergent history (e.g. a re-sealed forgery from a
-        different genesis or a rewritten past block) is refused. Our own tip
-        is exempt from the prefix check: it is still replaceable at its
-        height (ref: honest.go:649-653), so a peer holding the losing fork
-        block must still be able to adopt the canonical longer chain.
-        Finally (c) blocks are deep-copied so the supplier cannot mutate our
-        chain afterwards.
+        Rule: WEIGHT (count of non-empty blocks) then LENGTH, from the same
+        pinned genesis, structurally verified, deep-copied. Weight means a
+        fabricated chain of free-to-seal empty filler can never displace
+        real history. Weight itself is only unforgeable when the non-empty
+        blocks' update records are authenticated — the ledger layer checks
+        structure only, so the RUNTIME must (and does) verify each
+        candidate block's verifier-signature quorums against the committees
+        the candidate chain itself elects before calling this
+        (PeerAgent._chain_quorums_ok); callers adopting from untrusted
+        suppliers without that check inherit the reference's blind-adopt
+        trust model.
         """
-        if len(other.blocks) <= len(self.blocks):
+        # Fork choice on rejoin: WEIGHT-then-length, where weight = number
+        # of non-empty blocks. The reference adopts any longer chain
+        # blindly (main.go:1001-1013); pure length would let anyone
+        # fabricate a long chain of empty timeout-filler (empty blocks are
+        # free to seal) and wipe real history. Weighing non-empty blocks
+        # means a partitioned minority that padded its chain with empties —
+        # or even minted a minority-side real block — heals onto the
+        # majority chain (which accumulated strictly more real rounds),
+        # while an attacker must out-mint the honest network's real blocks
+        # to rewrite anything. Genesis is pinned: a chain grown from a
+        # forged genesis is refused outright.
+        if not other.blocks or not self.blocks or \
+                other.blocks[0].hash != self.blocks[0].hash:
+            return False  # different genesis — refuse before any O(n) work
+
+        def weight(blocks):
+            return sum(1 for b in blocks if not b.is_empty())
+
+        mine_key = (weight(self.blocks), len(self.blocks))
+        theirs_key = (weight(other.blocks), len(other.blocks))
+        if theirs_key <= mine_key:
             return False
         try:
             other.verify()
         except ChainInvariantError:
             return False
-        # the tip is exempt only when there IS a non-genesis tip: genesis is
-        # deterministic and never replaceable, so a genesis-only peer must
-        # still refuse a chain grown from a forged genesis
-        settled = self.blocks[:-1] if len(self.blocks) > 1 else self.blocks
-        for mine, theirs in zip(settled, other.blocks):
-            if mine.hash != theirs.hash:
-                return False
         self.blocks = copy.deepcopy(other.blocks)
         return True
 

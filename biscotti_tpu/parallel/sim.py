@@ -130,7 +130,14 @@ class Simulator:
         )
         self._noise_alpha = alpha if self.mode == "sgd" else 1.0
         self._round_step_raw = self._build_round_step()
-        self.round_step = jax.jit(self._round_step_raw, donate_argnums=(0, 1))
+        self._round_step_jit = jax.jit(self._round_step_raw,
+                                       donate_argnums=(0, 1))
+
+        def round_step(w, stake, it):
+            return self._round_step_jit(w, stake, it, self.x, self.y,
+                                        self.x_val, self.y_val)
+
+        self.round_step = round_step
 
     # ------------------------------------------------------------------ build
 
@@ -166,7 +173,12 @@ class Simulator:
             idx = sample_batch(key, self.rows, batch)
             return self._step(w, xi[idx], yi[idx])
 
-        def round_step(w, stake, it):
+        # data tensors are ARGUMENTS, not closure captures: a captured jnp
+        # array is baked into the HLO as a constant, which at CNN sizes
+        # makes the program itself hundreds of MB (the [N, rows, d] peer
+        # stack) — slow to compile and over upload limits on remote-compile
+        # setups. As arguments they stay device-resident buffers.
+        def round_step(w, stake, it, x, y, x_val, y_val):
             rkey = jax.random.fold_in(self.root_key, it)
             ckey, bkey, nkey = jax.random.split(rkey, 3)
             cidx = self._contributors(ckey)
@@ -174,7 +186,7 @@ class Simulator:
 
             bkeys = jax.vmap(lambda i: jax.random.fold_in(bkey, i))(cidx)
             deltas = jax.vmap(one_delta, in_axes=(None, 0, 0, 0))(
-                w, bkeys, self.x[cidx], self.y[cidx]
+                w, bkeys, x[cidx], y[cidx]
             )  # [S, d]
 
             if use_noise:
@@ -184,15 +196,15 @@ class Simulator:
                 noise = jnp.zeros_like(deltas)
             noised = deltas + noise
 
-            mask = defense_mask(defense, model, w, noised, self.x_val,
-                                self.y_val, cfg.roni_threshold,
+            mask = defense_mask(defense, model, w, noised, x_val,
+                                y_val, cfg.roni_threshold,
                                 default_num_adversaries(s))
             w_next = w + masked_aggregate(mask, deltas, noised, cfg.dp_in_model)
 
             delta_stake = jnp.where(mask, cfg.stake_unit, -cfg.stake_unit)
             stake_next = stake.at[cidx].add(delta_stake)
 
-            err = model.error_flat(w_next, self.x_val, self.y_val)
+            err = model.error_flat(w_next, x_val, y_val)
             return w_next, stake_next, mask, err
 
         return round_step
@@ -231,16 +243,17 @@ class Simulator:
         w, stake = self.init_state()
         step = self._round_step_raw
 
-        def body(carry, it):
-            w, stake = carry
-            w, stake, mask, err = step(w, stake, it)
-            return (w, stake), (err, jnp.sum(mask))
-
         @jax.jit
-        def full(w, stake):
+        def full(w, stake, x, y, x_val, y_val):
+            def body(carry, it):
+                w, stake = carry
+                w, stake, mask, err = step(w, stake, it, x, y, x_val, y_val)
+                return (w, stake), (err, jnp.sum(mask))
+
             return jax.lax.scan(body, (w, stake), jnp.arange(num_rounds))
 
-        (w, stake), (errs, accepted) = full(w, stake)
+        (w, stake), (errs, accepted) = full(w, stake, self.x, self.y,
+                                            self.x_val, self.y_val)
         return w, stake, np.asarray(errs), np.asarray(accepted)
 
     # ------------------------------------------------------------------ metrics

@@ -246,8 +246,14 @@ class Pool:
                    meta: Dict[str, Any] | None = None,
                    arrays: Dict[str, np.ndarray] | None = None,
                    timeout: float = 120.0):
+        # one deadline covers dial + send + reply: dialing must not grant
+        # the roundtrip a second full budget
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
         conn = await self._get(host, port, timeout)
-        rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays, timeout)
+        remaining = max(0.001, deadline - loop.time())
+        rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays,
+                                              remaining)
         if rmeta.get("error"):
             if rmeta.get("stale"):
                 raise StaleError(rmeta["error"])
@@ -260,8 +266,10 @@ class Pool:
         by the reader). Lets a broadcast encode its payload once and write
         the same bytes to every peer — at N=100 the per-peer re-encode of a
         multi-MB block was the event loop's dominant cost."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
         conn = await self._get(host, port, timeout)
-        await conn._send(frame, timeout)
+        await conn._send(frame, max(0.001, deadline - loop.time()))
 
     def close(self) -> None:
         for conn in self._conns.values():

@@ -1,0 +1,69 @@
+"""Device-time and phase profiling.
+
+Two instruments (SURVEY §5.1 — the reference's only timing signal is
+wall-clock deltas between log lines, parsed after the fact by
+eval_performance/parseLogs.py):
+
+* `device_trace(log_dir)` — context manager around `jax.profiler` so any
+  run (bench, sim, peer) can capture a real XLA device trace viewable in
+  TensorBoard/Perfetto.
+* `PhaseClock` — cheap cumulative wall-clock accounting by phase name
+  (sgd / noise / crypto_commit / share_gen / verify_wait / miner_verify /
+  recovery / transport). The peer agent carries one and returns the totals
+  with its result, which eval/eval_cost_breakdown.py turns into the
+  per-phase cost table (the reference's eval_cost_breakdown.pdf
+  equivalent, ref: usenix-eval/).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a jax.profiler device trace into `log_dir` (TensorBoard /
+    Perfetto format). No-op context if profiling is unavailable."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception:
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+class PhaseClock:
+    """Cumulative per-phase wall-clock accounting."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {"total_s": round(self.totals[name], 4),
+                   "calls": self.counts[name],
+                   "mean_s": round(self.totals[name] / self.counts[name], 5)}
+            for name in sorted(self.totals)
+        }

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Committee-size scaling eval — s/iteration as verifier/miner committees
+grow, over the real protocol runtime.
+
+Reference experiment: eval/eval_vrf_scale/runEval.sh (committee sweeps) and
+the BASELINE.md rows "Biscotti, 26 aggregators: 88-100 s/iter" and
+"5 noisers / 26 verifiers / 26 aggregators: 158 s/iter" at 100 nodes.
+Each cell is a real in-process TCP cluster (eval/scale_test.py).
+
+Artifacts: eval/results/committee_scale.csv + .json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (num_verifiers, num_miners, num_noisers) cells; the last two mirror the
+# reference's published large-committee operating points
+CELLS = [(3, 3, 2), (5, 5, 2), (10, 10, 2), (26, 26, 5)]
+
+
+def run_cell(nodes, dataset, nv, nm, nn, iterations, base_port):
+    cmd = [sys.executable, os.path.join(REPO, "eval", "scale_test.py"),
+           "--nodes", str(nodes), "--dataset", dataset,
+           "--iterations", str(iterations), "--verification", "1",
+           "--num-verifiers", str(nv), "--num-miners", str(nm),
+           "--num-noisers", str(nn), "--base-port", str(base_port)]
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no summary: {out.stdout[-300:]} {out.stderr[-300:]}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--out", default="eval/results")
+    args = ap.parse_args(argv)
+
+    rows = []
+    port = 28000
+    for nv, nm, nn in CELLS:
+        cell = run_cell(args.nodes, args.dataset, nv, nm, nn,
+                        args.iterations, port)
+        port += args.nodes + 10
+        row = {"verifiers": nv, "miners": nm, "noisers": nn,
+               "s_per_iter": cell["s_per_iter"],
+               "chains_equal": cell["chains_equal"]}
+        rows.append(row)
+        print(json.dumps(row))
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "committee_scale.csv"), "w") as f:
+        f.write("verifiers,miners,noisers,s_per_iter\n")
+        for r in rows:
+            f.write(f"{r['verifiers']},{r['miners']},{r['noisers']},"
+                    f"{r['s_per_iter']}\n")
+    with open(os.path.join(args.out, "committee_scale.json"), "w") as f:
+        json.dump({"experiment": "committee_scale", "nodes": args.nodes,
+                   "dataset": args.dataset, "rows": rows,
+                   "reference": {"26_aggregators": "88-100 s/iter",
+                                 "5n_26v_26m": "158 s/iter"}}, f, indent=1)
+    ok = all(r["chains_equal"] for r in rows)
+    print(json.dumps({"summary": "all_cells_chain_equal", "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Per-phase cost breakdown — where a protocol round's time goes.
+
+The reference published this as a figure (ref:
+usenix-eval/eval_cost_breakdown.pdf) derived from wall-clock deltas in
+node logs; here every peer carries a PhaseClock and reports exact
+cumulative per-phase times (sgd / crypto_commit / share_gen / verify_wait
+/ miner_verify / recovery / metrics), and an optional `jax.profiler`
+device trace can be captured with --trace-dir (SURVEY §5.1).
+
+Artifacts: eval/results/cost_breakdown.json + .csv.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--nodes", type=int, default=20)
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--secure-agg", type=int, default=1)
+    ap.add_argument("--out", default="eval/results")
+    ap.add_argument("--trace-dir", default="",
+                    help="also capture a jax.profiler device trace here")
+    ap.add_argument("--platform", default="cpu")
+    args = ap.parse_args(argv)
+    os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+    jax.config.update("jax_enable_x64", True)
+
+    from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+    from biscotti_tpu.runtime.peer import PeerAgent
+    from biscotti_tpu.utils.profiling import device_trace
+
+    timeouts = Timeouts(update_s=20, block_s=60, krum_s=15, share_s=20,
+                        rpc_s=20)
+    cfgs = [
+        BiscottiConfig(
+            node_id=i, num_nodes=args.nodes, dataset=args.dataset,
+            base_port=29000, secure_agg=bool(args.secure_agg), noising=True,
+            verification=True, defense=Defense.KRUM,
+            max_iterations=args.iterations, convergence_error=0.0,
+            sample_percent=0.70, seed=2, timeouts=timeouts,
+        )
+        for i in range(args.nodes)
+    ]
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    import contextlib
+
+    ctx = (device_trace(args.trace_dir) if args.trace_dir
+           else contextlib.nullcontext())
+    with ctx:
+        agents, results = asyncio.run(go())
+
+    # aggregate per-phase totals across peers; normalize per round
+    phases = {}
+    for a in agents:
+        for name, row in a.phases.summary().items():
+            agg = phases.setdefault(name, {"total_s": 0.0, "calls": 0})
+            agg["total_s"] += row["total_s"]
+            agg["calls"] += row["calls"]
+    for name, agg in phases.items():
+        agg["total_s"] = round(agg["total_s"], 3)
+        agg["s_per_call"] = round(agg["total_s"] / max(1, agg["calls"]), 5)
+
+    dumps = [r["chain_dump"] for r in results]
+    summary = {
+        "experiment": "cost_breakdown",
+        "dataset": args.dataset, "nodes": args.nodes,
+        "iterations": args.iterations,
+        "secure_agg": bool(args.secure_agg),
+        "chains_equal": all(d == dumps[0] for d in dumps),
+        "phases": dict(sorted(phases.items(),
+                              key=lambda kv: -kv[1]["total_s"])),
+        "device_trace": args.trace_dir or None,
+    }
+    print(json.dumps(summary))
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "cost_breakdown.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    with open(os.path.join(args.out, "cost_breakdown.csv"), "w") as f:
+        f.write("phase,total_s,calls,s_per_call\n")
+        for name, agg in summary["phases"].items():
+            f.write(f"{name},{agg['total_s']},{agg['calls']},"
+                    f"{agg['s_per_call']}\n")
+    return 0 if summary["chains_equal"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

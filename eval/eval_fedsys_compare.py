@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""FedSys-vs-Biscotti scale comparison — s/iteration for both systems at
+several cluster sizes over the real protocol runtime.
+
+Reference experiment: eval/eval_FedSys_scale (Biscotti 38.2-42.0 s/iter vs
+FedSys 7.1-9.1 s/iter at 100 nodes across an Azure fleet) and
+eval/eval_performance/perf_breakdown_vsFedSys.sh (40/60/80/100 nodes).
+Each cell boots a real in-process TCP cluster via eval/scale_test.py.
+
+Artifacts: eval/results/fedsys_compare.csv + .json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cell(nodes, dataset, fedsys, iterations, base_port):
+    cmd = [sys.executable, os.path.join(REPO, "eval", "scale_test.py"),
+           "--nodes", str(nodes), "--dataset", dataset,
+           "--iterations", str(iterations), "--verification", "1",
+           "--base-port", str(base_port)]
+    if fedsys:
+        cmd.append("--fedsys")
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"no summary from cell: {out.stdout[-500:]}\n"
+                       f"{out.stderr[-500:]}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--sizes", default="40,100")
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--out", default="eval/results")
+    args = ap.parse_args(argv)
+
+    rows = []
+    port = 27000
+    for n in (int(s) for s in args.sizes.split(",")):
+        for fedsys in (False, True):
+            cell = run_cell(n, args.dataset, fedsys, args.iterations, port)
+            port += n + 10
+            row = {"nodes": n, "mode": cell["mode"],
+                   "s_per_iter": cell["s_per_iter"],
+                   "chains_equal": cell["chains_equal"],
+                   "final_error": round(cell["final_error"], 4)}
+            rows.append(row)
+            print(json.dumps(row))
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "fedsys_compare.csv"), "w") as f:
+        f.write("nodes,mode,s_per_iter,final_error\n")
+        for r in rows:
+            f.write(f"{r['nodes']},{r['mode']},{r['s_per_iter']},"
+                    f"{r['final_error']}\n")
+    with open(os.path.join(args.out, "fedsys_compare.json"), "w") as f:
+        json.dump({"experiment": "fedsys_compare", "dataset": args.dataset,
+                   "iterations": args.iterations, "rows": rows,
+                   "host_note": "all peers share one host; see scale_test",
+                   "reference": {"biscotti_100": "38.2-42.0 s/iter",
+                                 "fedsys_100": "7.1-9.1 s/iter"}},
+                  f, indent=1)
+    ok = all(r["chains_equal"] for r in rows)
+    print(json.dumps({"summary": "all_cells_chain_equal", "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

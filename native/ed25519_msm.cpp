@@ -264,54 +264,6 @@ const Consts &consts() {
   return c;
 }
 
-// RFC 8032 §5.1.3 decompression: 32-byte compressed -> extended coords.
-// Returns false for a non-canonical y or an off-curve encoding.
-bool ge_decompress(const uint8_t in[32], ge &out) {
-  // canonical y check: y (with sign bit cleared) must be < p
-  uint8_t yb[32];
-  memcpy(yb, in, 32);
-  int sign = yb[31] >> 7;
-  yb[31] &= 0x7F;
-  static const uint8_t pbytes[32] = {
-      0xED, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
-      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
-      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F};
-  for (int i = 31; i >= 0; i--) {
-    if (yb[i] < pbytes[i]) break;
-    if (yb[i] > pbytes[i]) return false;
-    if (i == 0) return false;  // y == p
-  }
-  fe y = fe_frombytes(yb);
-  fe y2 = fe_sq(y);
-  fe u = fe_sub(y2, fe_one());           // y^2 - 1
-  fe v = fe_add(fe_mul(consts().d, y2), fe_one());  // d*y^2 + 1
-  // candidate x = u * v^3 * (u * v^7)^((p-5)/8); (p-5)/8 = 2^252 - 3
-  uint8_t e[32];
-  memset(e, 0xFF, 32);
-  e[31] = 0x0F;
-  e[0] = 0xFD;  // 2^252 - 3 low byte
-  fe v3 = fe_mul(fe_sq(v), v);
-  fe v7 = fe_mul(fe_sq(v3), v);
-  fe x = fe_mul(fe_mul(u, v3), fe_pow(fe_mul(u, v7), e));
-  fe vx2 = fe_mul(v, fe_sq(x));
-  if (fe_eq(vx2, u)) {
-    // ok
-  } else if (fe_eq(vx2, fe_sub(fe_zero(), u))) {
-    x = fe_mul(x, consts().sqrt_m1);
-  } else {
-    return false;
-  }
-  if (fe_is_zero(x) && sign) return false;
-  uint8_t xb[32];
-  fe_tobytes(xb, x);
-  if ((xb[0] & 1) != sign) x = fe_sub(fe_zero(), x);
-  out.X = x;
-  out.Y = y;
-  out.Z = fe_one();
-  out.T = fe_mul(x, y);
-  return true;
-}
-
 }  // namespace
 
 // ------------------------------------------------------------------- C ABI
@@ -418,20 +370,43 @@ int ed25519_scalarmult(const uint8_t *scalar, const uint8_t *point,
   return ed25519_msm(scalar, point, 1, out);
 }
 
-// Batch point decompression: n×32-byte compressed encodings →
-// n×128-byte extended (X,Y,Z,T) buffers, the input format of ed25519_msm.
-// Returns 0 when every point decodes, else 1+index of the first invalid
-// encoding. This is the miner-side hot spot of VSS share verification —
-// one decompression per committed coefficient (d per update), which in
-// pure Python (a sqrt mod p each) dwarfed the MSM itself.
-int ed25519_decompress_batch(const uint8_t *comp, size_t n, uint8_t *out) {
+// Batch affine-coordinate loader: n×64-byte (x,y) little-endian pairs →
+// n×128-byte extended (X,Y,Z,T) buffers. Each point is checked canonical
+// (x, y < p) and ON-CURVE (-x² + y² == 1 + d·x²·y²) — ~7 field mults per
+// point versus the ~255 squarings a compressed-point sqrt costs, which is
+// why the VSS wire format ships affine pairs. Subgroup membership is NOT
+// checked (callers fold the cofactor 8 into their verification scalars).
+// Returns 0 when every point loads, else 1+index of the first bad one.
+int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
+  static const uint8_t pbytes[32] = {
+      0xED, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
+      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F};
+  auto canonical = [](const uint8_t *b) {
+    for (int i = 31; i >= 0; i--) {
+      if (b[i] < pbytes[i]) return true;
+      if (b[i] > pbytes[i]) return false;
+    }
+    return false;  // == p
+  };
   for (size_t i = 0; i < n; i++) {
-    ge p;
-    if (!ge_decompress(comp + i * 32, p)) return (int)(i + 1);
-    fe_tobytes(out + i * 128, p.X);
-    fe_tobytes(out + i * 128 + 32, p.Y);
-    fe_tobytes(out + i * 128 + 64, p.Z);
-    fe_tobytes(out + i * 128 + 96, p.T);
+    const uint8_t *xb = xy + i * 64;
+    const uint8_t *yb = xb + 32;
+    if (!canonical(xb) || !canonical(yb)) return (int)(i + 1);
+    fe x = fe_frombytes(xb);
+    fe y = fe_frombytes(yb);
+    fe x2 = fe_sq(x);
+    fe y2 = fe_sq(y);
+    // -x^2 + y^2 == 1 + d*x^2*y^2
+    fe lhs = fe_sub(y2, x2);
+    fe rhs = fe_add(fe_one(), fe_mul(consts().d, fe_mul(x2, y2)));
+    if (!fe_eq(lhs, rhs)) return (int)(i + 1);
+    fe_tobytes(out + i * 128, x);
+    fe_tobytes(out + i * 128 + 32, y);
+    fe one = fe_one();
+    fe_tobytes(out + i * 128 + 64, one);
+    fe t = fe_mul(x, y);
+    fe_tobytes(out + i * 128 + 96, t);
   }
   return 0;
 }

@@ -176,12 +176,12 @@ def test_high_degree_commitment_rejected():
     agent = PeerAgent(cfg)
     agent.role_map = R.RoleMap.build(3, verifiers=[1], miners=[0])
     c = ss.num_chunks(agent.trainer.num_params, cfg.poly_size)
-    comms = np.zeros((c, 2 * cfg.poly_size, 32), dtype=np.uint8)
+    comms = np.zeros((c, 2 * cfg.poly_size, 64), dtype=np.uint8)
     commitment = cm.vss_digest(comms)
     rows = np.zeros((cfg.shares_per_miner, c), dtype=np.int64)
     blind = np.zeros((cfg.shares_per_miner, c, 32), dtype=np.uint8)
-    ok, why = agent._check_secret(
-        commitment, rows, {"iteration": 0, "source_id": 2},
+    ok, why = agent._check_secret_intake(
+        commitment, {"iteration": 0, "source_id": 2},
         {"comms": comms, "blind_rows": blind, "share_rows": rows})
     assert not ok and "shape" in why
 
@@ -203,6 +203,40 @@ def test_signature_replay_across_rounds_fails():
     assert agent._verify_sig_quorum(commitment, 0, 2, [1], [sig])
     assert not agent._verify_sig_quorum(commitment, 1, 2, [1], [sig])
     assert not agent._verify_sig_quorum(commitment, 0, 1, [1], [sig])
+
+
+def test_forged_heavy_chain_refused_without_quorums():
+    # chain WEIGHT (non-empty count) drives fork choice, so weight must be
+    # unforgeable: a fabricated chain of "non-empty" blocks whose updates
+    # carry no verifier quorum must fail runtime authentication even though
+    # it is structurally valid and heavier than ours
+    from biscotti_tpu.ledger.block import Block, BlockData, Update
+
+    cfg = _cfg(0, 4, 25080, verification=True)
+    agent = PeerAgent(cfg)
+    blocks = [agent.chain.blocks[0]]
+    for i in range(3):
+        prev = blocks[-1]
+        forged = Update(source_id=1, iteration=i,
+                        delta=np.zeros(0, np.float64),
+                        commitment=b"\x11" * 32, accepted=True)
+        blocks.append(Block(
+            data=BlockData(iteration=i,
+                           global_w=np.ones(agent.trainer.num_params),
+                           deltas=[forged]),
+            prev_hash=prev.hash,
+            stake_map=dict(prev.stake_map)).seal())
+    from biscotti_tpu.ledger.chain import Blockchain
+
+    other = Blockchain.__new__(Blockchain)
+    other.blocks = blocks
+    other.verify()  # structurally fine — weight alone would win
+    assert not agent._chain_quorums_ok(blocks), \
+        "forged non-empty chain passed quorum authentication"
+    # and a forged non-empty LIVE block is refused the same way
+    agent._accept_block(blocks[1], gossip=False)
+    assert agent.chain.get_block(0) is None
+    assert agent.counters.get("block_quorum_rejected", 0) == 1
 
 
 def test_honest_secureagg_cluster_still_accepts_everyone():

@@ -43,19 +43,37 @@ def _mk_forged_full(chain):
     return blk
 
 
-def test_resealed_divergent_chain_not_adopted():
-    # a properly-sealed chain that rewrites *settled* history (divergence
-    # buried below our replaceable tip) must be refused even though verify()
-    # passes on it. (Divergence at the tip itself is allowed — the tip is
-    # replaceable, see test_adoption_with_losing_fork_tip.)
+def test_empty_padded_divergent_chain_not_adopted():
+    # Fork choice is weight (non-empty count) then length: empty blocks are
+    # free to seal, so a LONGER divergent chain padded with empty filler
+    # must be refused — otherwise anyone could wipe real history with
+    # fabricated timeout blocks. Rewriting history requires out-MINTING the
+    # honest chain's real blocks (same trust model as the reference's
+    # longest-chain adopt, main.go:1001-1013, but not free).
     honest = Blockchain(num_params=4, num_nodes=2)
     honest.add_block(_block(honest, ndeltas=1))
-    honest.add_block(_block(honest, ndeltas=1))  # height-0 is now settled
+    honest.add_block(_block(honest, ndeltas=1))
     evil = Blockchain(num_params=4, num_nodes=2)
+    evil.add_block(_block(evil, ndeltas=1))  # diverges at height 0
     for _ in range(4):
-        evil.add_block(_block(evil, ndeltas=2))  # diverges at height 0
+        evil.add_block(_block(evil, ndeltas=0))  # longer, but empty padding
     evil.verify()  # structurally fine
     assert honest.maybe_adopt(evil) is False
+    # equal weight + equal length likewise refused (no flapping)
+    assert honest.maybe_adopt(honest) is False
+
+
+def test_heavier_divergent_chain_adopted_after_partition():
+    # the healing side of the same rule: a minority that minted its own
+    # real block during a partition adopts the majority chain, which
+    # accumulated strictly more non-empty rounds
+    minority = Blockchain(num_params=4, num_nodes=2)
+    minority.add_block(_block(minority, ndeltas=1))  # its partition-side block
+    majority = Blockchain(num_params=4, num_nodes=2)
+    for _ in range(3):
+        majority.add_block(_block(majority, ndeltas=2))
+    assert minority.maybe_adopt(majority) is True
+    assert minority.dump() == majority.dump()
 
 
 def test_adopted_blocks_are_isolated_copies():

@@ -1,0 +1,163 @@
+"""Fault-injection parity tests: kill-and-RESTART and a partition window.
+
+The reference drives these with shell scripts — kill a random node with
+`fuser -k` and relaunch it in a loop (ref: DistSys/failAndRestartLocal.sh:1-33)
+and a 30 s iptables DROP window (ref: DistSys/blockNode.sh:1-17); its
+in-harness partition tests were left commented out (localTest.sh:100-250).
+Here both scenarios run as in-process clusters with real TCP loopback and
+end with the chain-equality oracle.
+"""
+
+import asyncio
+
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.runtime.rpc import StaleError
+
+FAST = Timeouts(update_s=3.0, block_s=8.0, krum_s=3.0, share_s=3.0, rpc_s=4.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=4, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+async def _hard_stop(agent: PeerAgent, task: asyncio.Task) -> None:
+    """Simulate a crash: cancel the agent's run loop and release its port."""
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):
+        pass
+    agent.pool.close()
+    await agent.server.stop()
+
+
+async def _wait_height(agent: PeerAgent, h: int, budget: float = 60.0) -> None:
+    """Event-driven pacing: rounds complete in fractions of a second once
+    jitted, so wall-clock sleeps race the cluster — gate on chain height."""
+    deadline = asyncio.get_event_loop().time() + budget
+    while agent.iteration < h:
+        assert asyncio.get_event_loop().time() < deadline, \
+            f"cluster never reached height {h}"
+        await asyncio.sleep(0.05)
+
+
+def test_kill_and_restart_rejoins_and_chain_matches():
+    n, port = 4, 25210
+    victim = 3
+    # enough rounds that the cluster is still mid-training when the victim
+    # rejoins — otherwise the reborn peer finds a finished, dead network
+    iters = 30
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port, max_iterations=iters))
+                  for i in range(n)]
+        tasks = [asyncio.ensure_future(a.run()) for a in agents]
+        await _wait_height(agents[0], 3)
+        await _hard_stop(agents[victim], tasks[victim])
+        await _wait_height(agents[0], 6)  # network mints on without it
+        # restart: a FRESH agent with the same identity rejoins mid-training
+        reborn = PeerAgent(_cfg(victim, n, port, max_iterations=iters))
+        reborn_task = asyncio.ensure_future(reborn.run())
+        results = await asyncio.gather(*tasks[:victim], reborn_task)
+        return agents[:victim], reborn, results
+
+    survivors, reborn, results = asyncio.run(go())
+    dumps = [r["chain_dump"].splitlines() for r in results]
+    # settled-prefix oracle: every block below each pair's common tip must
+    # match (the very last round can legitimately still be propagating when
+    # max_iterations stops the cluster)
+    common = min(len(d) for d in dumps) - 1
+    assert common >= 3, f"network made no progress: {dumps}"
+    for d in dumps[1:]:
+        assert d[:common] == dumps[0][:common], \
+            "restarted peer did not converge to the network's chain"
+    assert any("ndeltas=0" not in ln for ln in dumps[0][1:common])
+
+
+class PartitionedPeer(PeerAgent):
+    """Drops traffic across a configurable cut, like an iptables window
+    (ref: blockNode.sh). The cut is a class attribute so every agent in the
+    test shares one switch. The cut is enforced at the POOL level so every
+    transport path is covered — including the minted-block broadcast,
+    which bypasses _call via pool.post."""
+
+    cut = set()  # ids on the minority side
+
+    def __init__(self, cfg, **kw):
+        super().__init__(cfg, **kw)
+        orig_call = self.pool.call
+        orig_post = self.pool.post
+
+        def blocked(port: int) -> bool:
+            pid = port - self.cfg.base_port
+            return (self.id in PartitionedPeer.cut) != \
+                (pid in PartitionedPeer.cut)
+
+        async def call(host, port, *a, **k):
+            if blocked(port):
+                raise ConnectionError("partitioned")
+            return await orig_call(host, port, *a, **k)
+
+        async def post(host, port, *a, **k):
+            if blocked(port):
+                raise ConnectionError("partitioned")
+            return await orig_post(host, port, *a, **k)
+
+        self.pool.call = call
+        self.pool.post = post
+
+
+def test_partition_window_heals_and_chain_matches():
+    n, port = 4, 25220
+    minority = {3}
+
+    async def go():
+        agents = [PartitionedPeer(_cfg(i, n, port, max_iterations=40))
+                  for i in range(n)]
+        tasks = [asyncio.ensure_future(a.run()) for a in agents]
+        await _wait_height(agents[0], 3)
+        cut_height = agents[0].iteration
+        PartitionedPeer.cut = set(minority)  # drop the cut mid-run
+        # hold the cut long enough that the minority mints fork filler
+        # (its rounds only advance at block_s) while the majority keeps
+        # minting real blocks
+        await asyncio.sleep(FAST.block_s + 2.0)
+        await _wait_height(agents[0], cut_height + 3)
+        PartitionedPeer.cut = set()  # heal
+        results = await asyncio.gather(*tasks)
+        return agents, results
+
+    try:
+        agents, results = asyncio.run(go())
+    finally:
+        PartitionedPeer.cut = set()
+    majority_dumps = [r["chain_dump"] for r, a in zip(results, agents)
+                      if a.id not in minority]
+    assert all(d == majority_dumps[0] for d in majority_dumps)
+    minority_res = next(r for r, a in zip(results, agents)
+                        if a.id in minority)
+    minority_dump = minority_res["chain_dump"]
+    # the cut must have actually isolated the minority: it rode its block
+    # timer at least once while the majority minted on without it
+    assert minority_res["counters"].get("block_timeout_empty_fallback", 0) \
+        >= 1, "partition never took effect"
+    # the healed minority peer must share the majority's settled prefix:
+    # every block at a height both sides hold must match, except possibly
+    # the divergent tip if the run ended mid-heal
+    maj = majority_dumps[0].splitlines()
+    mino = minority_dump.splitlines()
+    common = min(len(maj), len(mino)) - 1
+    assert common >= 2
+    assert maj[:common] == mino[:common], (
+        f"fork did not heal:\nmajority={maj}\nminority={mino}")
