@@ -120,8 +120,11 @@ class BiscottiConfig:
     # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
     learning_rate: float = 1e-3  # torch-path SGD lr (used by optimizer-step modes)
     logreg_alpha: float = 1e-2  # numpy-logreg step size α (ref: logistic_model.py:12)
-    momentum: float = 0.75
-    weight_decay: float = 1e-3
+    # NOTE deliberately absent: momentum / weight_decay. The reference
+    # configures SGD(momentum=.75, weight_decay=1e-3) (client.py:30) but its
+    # protocol path never calls optimizer.step() — privateFun returns the
+    # clipped −grad only (client.py:38-65) — so the knobs do nothing there;
+    # carrying dead fields here would imply behavior we (and it) don't have.
     grad_clip: float = 100.0
     batch_size: int = 10
     noise_presample_iters: int = 100  # DP noise tensor depth (client_obj.py:59-67)

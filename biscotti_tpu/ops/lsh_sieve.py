@@ -1,0 +1,46 @@
+"""LSH-sieve aggregator — sybil/duplicate attenuation (XLA kernel).
+
+The reference's experimental extra defense builds a falconn LSH index over
+the centred updates and divides each update's contribution by its
+near-neighbor count, so a cluster of (near-)identical sybil updates sums
+to ~one update's worth of influence (ref: ML/code/logistic_aggregator.py:7-27).
+
+TPU-native redesign: random-hyperplane LSH. B threefry-drawn hyperplanes
+give every update a B-bit sign code (one [n,d]×[d,B] matmul — MXU work);
+near-neighbors are pairs whose codes differ in ≤ radius bits, counted with
+a single ±1 code Gram matrix (another matmul). No index structure, no
+host loops — two matmuls and a compare, batched over all n updates at
+once, where falconn's query loop was per-update on the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("num_planes", "radius"))
+def lsh_sieve_weights(deltas: jax.Array, key: jax.Array,
+                      num_planes: int = 64, radius: int = 2) -> jax.Array:
+    """Per-update attenuation weights 1/|near-neighbors| (self included, so
+    weights ∈ (0, 1]). deltas: [n, d] float."""
+    n, d = deltas.shape
+    centred = deltas - jnp.mean(deltas, axis=0, keepdims=True)
+    planes = jax.random.normal(key, (d, num_planes), deltas.dtype)
+    codes = jnp.where(centred @ planes >= 0, 1.0, -1.0)  # [n, B]
+    # hamming(i,j) = (B − codes_i·codes_j) / 2
+    gram = codes @ codes.T  # [n, n]
+    hamming = (num_planes - gram) / 2.0
+    neighbors = jnp.sum(hamming <= radius, axis=1)  # ≥ 1 (self)
+    return 1.0 / neighbors.astype(deltas.dtype)
+
+
+@partial(jax.jit, static_argnames=("num_planes", "radius"))
+def lsh_sieve_aggregate(deltas: jax.Array, key: jax.Array,
+                        num_planes: int = 64, radius: int = 2) -> jax.Array:
+    """Σᵢ wᵢ·deltaᵢ with LSH attenuation weights — the reference's
+    `lsh_sieve` aggregate (ref: logistic_aggregator.py:20-27)."""
+    w = lsh_sieve_weights(deltas, key, num_planes, radius)
+    return jnp.sum(deltas * w[:, None], axis=0)

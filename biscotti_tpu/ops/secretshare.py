@@ -115,12 +115,32 @@ def vandermonde(xs: jax.Array, poly_size: int = POLY_SIZE) -> jax.Array:
     return xs[:, None] ** powers[None, :]
 
 
+# ---- shared kernel bodies: the CPU-pinned jit wrappers and the
+# chunk-sharded shard_map wrappers below must stay mathematically
+# identical, so both call these
+
+
+def _shares_kernel(coeffs: jax.Array, v: jax.Array) -> jax.Array:
+    """[C, k] coefficients × [S, k] Vandermonde → [S, C] shares."""
+    return v @ coeffs.T
+
+
+def _agg_kernel(peer_shares: jax.Array) -> jax.Array:
+    return jnp.sum(peer_shares, axis=0)
+
+
+def _recover_kernel(agg: jax.Array, vv: jax.Array) -> jax.Array:
+    """float64 least-squares per chunk, rounded back to int64."""
+    sol, _, _, _ = jnp.linalg.lstsq(vv, agg.astype(jnp.float64))
+    return jnp.round(sol.T).astype(jnp.int64)
+
+
 @partial(jax.jit, static_argnames=("poly_size", "total_shares"))
 def _make_shares_jit(q: jax.Array, poly_size: int,
                      total_shares: int) -> jax.Array:
     coeffs = to_chunks(q, poly_size)  # [C, k]
     v = vandermonde(share_xs(total_shares), poly_size)  # [S, k]
-    return v @ coeffs.T  # [S, C]
+    return _shares_kernel(coeffs, v)  # [S, C]
 
 
 def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
@@ -145,7 +165,7 @@ def miner_rows(total_shares: int, miner_idx: int, num_miners: int) -> slice:
 
 @jax.jit
 def _aggregate_shares_jit(peer_shares: jax.Array) -> jax.Array:
-    return jnp.sum(peer_shares, axis=0)
+    return _agg_kernel(peer_shares)
 
 
 def aggregate_shares(peer_shares: jax.Array) -> jax.Array:
@@ -160,9 +180,8 @@ def aggregate_shares(peer_shares: jax.Array) -> jax.Array:
 @partial(jax.jit, static_argnames=("poly_size",))
 def _recover_coeffs_jit(agg_shares: jax.Array, xs: jax.Array,
                         poly_size: int) -> jax.Array:
-    v = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
-    sol, _, _, _ = jnp.linalg.lstsq(v, agg_shares.astype(jnp.float64))
-    return jnp.round(sol.T).astype(jnp.int64)  # [C, k]
+    vv = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
+    return _recover_kernel(agg_shares, vv)  # [C, k]
 
 
 def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
@@ -186,3 +205,56 @@ def recover_update(agg_shares: jax.Array, xs: jax.Array, num_params: int,
     (ref: honest.go:442-502 recoverAggregateUpdates)."""
     coeffs = recover_coeffs(agg_shares, xs, poly_size)
     return dequantize(from_chunks(coeffs, num_params), precision)
+
+
+# ----------------------------------------------------- chunk-axis sharding
+#
+# SURVEY §5.7: the reference scales model dim d only through its O(d)
+# commitment cost — its honest analogue of sequence sharding is the
+# polynomial CHUNK axis of the secret-sharing tensors. The chunk axis is
+# embarrassingly parallel (every chunk's polynomial is independent: share
+# generation, aggregation, and per-chunk least-squares recovery touch no
+# other chunk), so sharding it over a mesh needs NO collectives until the
+# final from_chunks reshape — large-d models split their share tensors
+# across devices and each device runs the identical small program.
+
+
+def make_sharded_share_fns(mesh, axis: str = "chunks",
+                           poly_size: int = POLY_SIZE,
+                           total_shares: int = 2 * POLY_SIZE):
+    """shard_map share pipeline over the chunk axis. Returns
+    (make_shares_sh, aggregate_sh, recover_coeffs_sh):
+
+        make_shares_sh(coeffs [C,k] int64)        -> [S, C] shares
+        aggregate_sh(peer_shares [P,S,C])         -> [S, C]
+        recover_coeffs_sh(agg [S,C], xs [S])      -> [C, k]
+
+    C must divide over the mesh axis size. Runs wherever the mesh lives —
+    the 8-device virtual CPU mesh in tests; on TPU pods this axis rides
+    hosts (int64 — see module docstring on device placement)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    _require_x64("make_sharded_share_fns")
+    v = vandermonde(share_xs(total_shares), poly_size)  # [S, k], replicated
+
+    def _make(coeffs):  # [C_loc, k] -> [S, C_loc]
+        return _shares_kernel(coeffs, v)
+
+    def _agg(peer_shares):  # [P, S, C_loc] -> [S, C_loc]
+        return _agg_kernel(peer_shares)
+
+    def _recover(agg, xs):  # [S, C_loc] -> [C_loc, k]
+        return _recover_kernel(agg, vandermonde(xs, poly_size)
+                               .astype(jnp.float64))
+
+    make_sh = jax.jit(shard_map(
+        _make, mesh=mesh, in_specs=(P(axis, None),),
+        out_specs=P(None, axis), check_vma=False))
+    agg_sh = jax.jit(shard_map(
+        _agg, mesh=mesh, in_specs=(P(None, None, axis),),
+        out_specs=P(None, axis), check_vma=False))
+    recover_sh = jax.jit(shard_map(
+        _recover, mesh=mesh, in_specs=(P(None, axis), P()),
+        out_specs=P(axis, None), check_vma=False))
+    return make_sh, agg_sh, recover_sh

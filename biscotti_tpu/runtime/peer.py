@@ -35,7 +35,7 @@ import os
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -111,14 +111,23 @@ class RoundState:
     # carried into the minted block as accepted=False records and debited
     # STAKE_UNIT (ref: honest.go:363-370 debits rejected block updates)
     miner_rejected: Dict[int, Update] = field(default_factory=dict)
+    # the one aggregation set this miner will serve this round: releasing
+    # aggregates over a SECOND, different subset would let a malicious
+    # leader difference the two sums and unmask an individual update
+    served_part: Optional[List[int]] = None
     block_done: Optional[asyncio.Event] = None
     tasks: List[asyncio.Task] = field(default_factory=list)
 
 
 class PeerAgent:
     def __init__(self, cfg: BiscottiConfig, key_dir: str = "",
-                 log_path: str = "", ckpt_dir: str = "", ckpt_every: int = 10):
+                 log_path: str = "", ckpt_dir: str = "", ckpt_every: int = 10,
+                 stepper=None):
         self.cfg = cfg
+        # peers-as-devices mode: a shared BatchStepper computes ALL local
+        # peers' SGD deltas in one sharded XLA call per round
+        # (runtime/device_cluster.py); None = per-agent trainer dispatch
+        self.stepper = stepper
         self.ckpt_dir = ckpt_dir
         self.ckpt_every = max(1, ckpt_every)
         self.id = cfg.node_id
@@ -175,8 +184,13 @@ class PeerAgent:
 
         self.timeouts = cfg.timeouts  # already-scaled instance may be passed
         self.pool = rpc.Pool()  # persistent multiplexed connections
-        self.server = rpc.RPCServer(cfg.my_ip, cfg.port_of(self.id),
-                                    self._handle)
+        # with a peers file the PORT layout is the dealer's, not
+        # base_port+id arithmetic; the bind ADDRESS stays cfg.my_ip — the
+        # peers-file entry is how others reach us, which behind NAT is not
+        # a local interface we could bind
+        bind_port = (self.peers[self.id][1] if cfg.peers_file
+                     else cfg.port_of(self.id))
+        self.server = rpc.RPCServer(cfg.my_ip, bind_port, self._handle)
         self.round = RoundState(iteration=self.chain.next_iteration)
         self.role_map = R.RoleMap({i: 1 for i in range(cfg.num_nodes)})
         self.logs: List[Tuple[int, float, float]] = []  # iter, err, ts
@@ -902,24 +916,77 @@ class PeerAgent:
                     accepted=sorted(accepted))
         st.krum_decision.set_result(accepted)
 
+    @staticmethod
+    def _part_message(kind: str, iteration: int, nodes: Sequence[int]) -> bytes:
+        """Domain-separated leader-request message for share-release RPCs."""
+        payload = f"biscotti-{kind}:{iteration}:" \
+                  f"{','.join(str(n) for n in nodes)}"
+        return hashlib.sha256(payload.encode()).digest()
+
+    def _check_leader_request(self, kind: str, it: int,
+                              nodes: Sequence[int], meta) -> None:
+        """Share-release RPCs must come from the round's leader miner,
+        proven by a Schnorr signature — without this ANY caller could pull
+        aggregated share rows and difference subsets to unmask individual
+        updates (the reference shares this weakness; ADVICE round-1 low)."""
+        if not self.cfg.verification or self.cfg.fedsys:
+            return  # signature-less modes (ref parity)
+        _, miners, _, _ = self.role_map.committee()
+        leader = self._miner_leader(sorted(miners))
+        src = int(meta.get("source_id", -1))
+        if src != leader:
+            raise RPCError("share release restricted to the leader miner")
+        try:
+            sig = bytes.fromhex(meta.get("sig", ""))
+        except ValueError:
+            raise RPCError("malformed leader signature")
+        pub = self.node_pubs.get(leader)
+        if not pub or not cm.schnorr_verify(
+                pub, self._part_message(kind, it, nodes), sig):
+            raise RPCError("leader signature failed verification")
+
     async def _h_get_update_list(self, meta, arrays):
         """Leader-miner asks which sources this miner holds shares for
         (ref: main.go:438-457, 2237-2277)."""
         it = int(meta["iteration"])
         st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
+        self._check_leader_request("update-list", it, [], meta)
         await self._verify_intake(st)
         srcs = sorted(st.miner_shares)
         return {"sources": srcs, "rejected": sorted(st.miner_rejected)}, {}
 
     async def _h_get_miner_part(self, meta, arrays):
         """Leader-miner collects this miner's share slice, aggregated over
-        the agreed node list (ref: main.go:459-485, kyber.go:244-287)."""
+        the agreed node list (ref: main.go:459-485, kyber.go:244-287).
+        Release conditions: leader-signed request, a minimum aggregation
+        set (an aggregate over one node IS that node's update), and at most
+        ONE distinct set per round (a second subset could be differenced
+        against the first to isolate an individual)."""
         it = int(meta["iteration"])
         st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
-        await self._verify_intake(st)
         nodes = [int(x) for x in meta["nodes"]]
+        self._check_leader_request("miner-part", it, nodes, meta)
+        await self._verify_intake(st)
+        if len(set(nodes)) != len(nodes):
+            # [v, v] would pass the size floor yet aggregate to 2·share_v
+            raise RPCError("duplicate nodes in aggregation set")
         if not all(n in st.miner_shares for n in nodes):
             raise RPCError("missing shares for requested nodes")
+        if len(nodes) < min(2, len(st.miner_shares)):
+            raise RPCError("aggregation set below privacy floor")
+        if st.served_part is not None and st.served_part != sorted(nodes):
+            raise RPCError("a different aggregation set was already served")
+        # KNOWN RESIDUAL (documented, strictly better than the reference,
+        # which serves any subset to any caller any number of times): the
+        # once-only guard is per-miner, and the share layout's 2× row
+        # redundancy (TOTAL_SHARES = 2·POLY_SIZE) means any ⌈M/2⌉ miners'
+        # rows suffice for recovery — a malicious leader could serve set S
+        # to one disjoint miner half and S∖{v} to the other and difference
+        # the two aggregates. Structural fixes (future work): redundancy
+        # < 2× forces any two recovering miner subsets to overlap in a
+        # miner whose once-only guard then fires; or an explicit signed
+        # set-agreement round among miners.
+        st.served_part = sorted(nodes)
         stack = np.stack([st.miner_shares[n] for n in nodes])
         agg = np.asarray(ss.aggregate_shares(stack))
         return {"nodes": nodes}, {"agg_rows": agg}
@@ -934,7 +1001,11 @@ class PeerAgent:
         # heavy device call off the event loop: in-process clusters share one
         # loop, and a blocked loop starves every peer's timers
         with self.phases.phase("sgd"):
-            delta = await asyncio.to_thread(self.trainer.private_fun, w, it)
+            if self.stepper is not None:
+                delta = await self.stepper.step(self.id, w, it)
+            else:
+                delta = await asyncio.to_thread(self.trainer.private_fun,
+                                                w, it)
         self.total_updates += 1
 
         noise = None
@@ -1154,8 +1225,11 @@ class PeerAgent:
                 if m == self.id:
                     continue
                 try:
-                    rmeta, _ = await self._call(m, "GetUpdateList",
-                                                {"iteration": it})
+                    rmeta, _ = await self._call(m, "GetUpdateList", {
+                        "iteration": it, "source_id": self.id,
+                        "sig": self._sign(self._part_message(
+                            "update-list", it, [])).hex(),
+                    })
                     node_sets.append(set(int(x) for x in rmeta["sources"]))
                 except Exception:
                     node_sets.append(set())
@@ -1172,9 +1246,12 @@ class PeerAgent:
                         slices[idx] = np.asarray(ss.aggregate_shares(stack))
                         continue
                     try:
-                        _, arrs = await self._call(
-                            m, "GetMinerPart",
-                            {"iteration": it, "nodes": nodes})
+                        _, arrs = await self._call(m, "GetMinerPart", {
+                            "iteration": it, "nodes": nodes,
+                            "source_id": self.id,
+                            "sig": self._sign(self._part_message(
+                                "miner-part", it, nodes)).hex(),
+                        })
                         slices[idx] = np.asarray(arrs["agg_rows"], np.int64)
                     except Exception:
                         ok = False

@@ -1,0 +1,161 @@
+"""Multi-host fleet launcher — the reference's Azure run driver as a
+single tool (ref: azure/azure-run/runBiscotti.sh: keygen, build, generate
+peersFileSent host:port list, ssh-launch nodesInEachVM processes per VM,
+collect logs; azure-util/killall + get-all-LogFiles).
+
+Targets a TPU pod or any ssh-reachable fleet: every host runs
+`nodes_per_host` peer agents (hosts-as-peers mode; for the
+peers-as-devices variant on a single host see
+runtime/device_cluster.py). `localhost` entries execute directly
+(subprocess), remote entries via ssh; --dry-run prints the exact
+per-host commands without executing, for driving real fleets from an
+orchestrator.
+
+    python -m biscotti_tpu.tools.pod_launch --hosts hosts.txt \
+        --nodes-per-host 5 --dataset mnist --iterations 5 \
+        [--key-dir keys/] [--dry-run]
+
+After a local run, the chain-equality oracle is applied across every
+peer's dump (ref: DistSys/localTest.sh:40-96) and a JSON summary printed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def read_hosts(path: str):
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip() and not ln.startswith("#")]
+
+
+def write_peers_file(hosts, nodes_per_host, base_port, out_path):
+    """host:port per line, nodes_per_host consecutive ids per host
+    (ref: peersFileSent in runBiscotti.sh). Ports are base_port+global_id:
+    distinct hosts don't collide anyway, and a localhost-only fleet (every
+    'host' the same machine) still gets unique ports."""
+    with open(out_path, "w") as f:
+        node_id = 0
+        for h in hosts:
+            addr = "127.0.0.1" if h == "localhost" else h
+            for _ in range(nodes_per_host):
+                f.write(f"{addr}:{base_port + node_id}\n")
+                node_id += 1
+
+
+def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
+    cmd = [sys.executable, "-m", "biscotti_tpu.runtime.peer",
+           "-i", str(node_id), "-t", str(total),
+           "-d", args.dataset, "-f", peers_file,
+           "-a", bind_ip,  # remote hosts bind all interfaces (NAT'd fleets)
+           "-p", str(args.base_port),
+           "-sa", str(args.secure_agg), "-np", str(args.noising),
+           "-vp", str(args.verification),
+           "--max-iterations", str(args.iterations),
+           "--seed", str(args.seed)]
+    if args.key_dir:
+        cmd += ["--key-dir", args.key_dir]
+    return cmd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", required=True,
+                    help="file with one host per line; 'localhost' runs "
+                         "in-place, anything else becomes an ssh command")
+    ap.add_argument("--nodes-per-host", type=int, default=5)
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--base-port", type=int, default=23500)
+    ap.add_argument("--iterations", type=int, default=5)
+    ap.add_argument("--secure-agg", type=int, default=0)
+    ap.add_argument("--noising", type=int, default=0)
+    ap.add_argument("--verification", type=int, default=1)
+    ap.add_argument("--key-dir", default="")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--peers-file", default="/tmp/biscotti_peers.txt")
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args(argv)
+
+    hosts = read_hosts(args.hosts)
+    total = len(hosts) * args.nodes_per_host
+    write_peers_file(hosts, args.nodes_per_host, args.base_port,
+                     args.peers_file)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    procs = []
+    node_id = 0
+    for h in hosts:
+        for _ in range(args.nodes_per_host):
+            bind_ip = "127.0.0.1" if h == "localhost" else "0.0.0.0"
+            cmd = peer_cmd(args, node_id, total, args.peers_file, bind_ip)
+            if h == "localhost":
+                if args.dry_run:
+                    print(f"[local] {' '.join(map(shlex.quote, cmd))}")
+                else:
+                    procs.append((node_id, subprocess.Popen(
+                        cmd, cwd=REPO, env=env,
+                        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                        text=True)))
+            else:
+                remote = (f"cd {shlex.quote(REPO)} && JAX_PLATFORMS=cpu "
+                          f"{' '.join(map(shlex.quote, cmd))}")
+                ssh = ["ssh", h, remote]
+                if args.dry_run:
+                    print(f"[ssh]   {' '.join(map(shlex.quote, ssh))}")
+                else:
+                    procs.append((node_id, subprocess.Popen(
+                        ssh, stdout=subprocess.PIPE,
+                        stderr=subprocess.DEVNULL, text=True)))
+            node_id += 1
+    if args.dry_run:
+        print(json.dumps({"dry_run": True, "total_nodes": total,
+                          "hosts": len(hosts),
+                          "peers_file": args.peers_file}))
+        return 0
+
+    deadline = time.time() + args.timeout
+    outs = {}
+    for nid, p in procs:
+        budget = max(1.0, deadline - time.time())
+        try:
+            out, _ = p.communicate(timeout=budget)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs[nid] = out or ""
+
+    def chain_of(text):
+        lines = text.splitlines()
+        try:
+            a = lines.index("=== CHAIN DUMP ===")
+            b = lines.index("=== LOGS ===")
+            return "\n".join(lines[a + 1: b])
+        except ValueError:
+            return ""
+
+    chains = {nid: chain_of(t) for nid, t in outs.items()}
+    ref = chains.get(0, "")
+    equal = bool(ref) and all(c == ref for c in chains.values())
+    summary = {
+        "total_nodes": total, "hosts": len(hosts),
+        "chains_equal": equal,
+        "blocks": len(ref.splitlines()) - 1 if ref else 0,
+    }
+    print(json.dumps(summary))
+    return 0 if equal else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

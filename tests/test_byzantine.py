@@ -239,6 +239,50 @@ def test_forged_heavy_chain_refused_without_quorums():
     assert agent.counters.get("block_quorum_rejected", 0) == 1
 
 
+def test_share_release_requires_leader_signature():
+    # aggregated share rows are the secure-agg privacy boundary: a caller
+    # who is not the round's leader miner (or who cannot produce the
+    # leader's signature over the exact node set) must be refused
+    import hashlib
+
+    from biscotti_tpu.runtime.rpc import RPCError
+
+    cfg = _cfg(0, 4, 25090, secure_agg=True, verification=True)
+    agent = PeerAgent(cfg)
+    agent.role_map = R.RoleMap.build(4, verifiers=[1], miners=[agent.id, 3])
+
+    async def attempt(meta):
+        st = agent.round
+        st.krum_decision = asyncio.get_running_loop().create_future()
+        try:
+            await agent._h_get_miner_part(meta, {})
+            return None
+        except RPCError as e:
+            return str(e)
+
+    async def go():
+        # wrong caller entirely
+        r1 = await attempt({"iteration": agent.iteration, "nodes": [0, 1],
+                            "source_id": 2, "sig": "00" * 64})
+        # right caller id (leader=3) but forged signature
+        r2 = await attempt({"iteration": agent.iteration, "nodes": [0, 1],
+                            "source_id": 3, "sig": "00" * 64})
+        # leader-signed but for a DIFFERENT node set
+        leader_seed = hashlib.sha256(f"schnorr-{cfg.seed}-3".encode()).digest()
+        from biscotti_tpu.crypto import commitments as cm
+
+        sig = cm.schnorr_sign(leader_seed, agent._part_message(
+            "miner-part", agent.iteration, [0, 2]))
+        r3 = await attempt({"iteration": agent.iteration, "nodes": [0, 1],
+                            "source_id": 3, "sig": sig.hex()})
+        return r1, r2, r3
+
+    r1, r2, r3 = asyncio.run(go())
+    assert r1 and "leader" in r1
+    assert r2 and "signature" in r2
+    assert r3 and "signature" in r3
+
+
 def test_honest_secureagg_cluster_still_accepts_everyone():
     # control: with no Byzantine peer the enforcement path accepts all
     # submissions and nobody is debited

@@ -1,0 +1,67 @@
+"""Peers-as-devices integration: device peers mint REAL blocks through the
+runtime (SURVEY §7.1's second launcher; VERDICT round-1 gap "the sharded
+data plane and the protocol control plane are never integrated").
+
+The 8-device CPU mesh (conftest) hosts all peers' SGD steps as ONE
+shard_map program per round, while the full asyncio protocol — verifier
+committees, secure-agg, block gossip — runs over real TCP loopback and the
+chain-equality oracle closes the loop.
+"""
+
+import asyncio
+import math
+
+import jax
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+from biscotti_tpu.runtime.device_cluster import BatchStepper, run_cluster
+
+FAST = Timeouts(update_s=4.0, block_s=20.0, krum_s=4.0, share_s=4.0, rpc_s=6.0)
+
+
+def _mesh():
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    import numpy as np
+
+    return jax.sharding.Mesh(np.array(devices), ("peers",))
+
+
+def test_device_peers_mint_real_blocks():
+    mesh = _mesh()
+    n_dev = math.prod(mesh.devices.shape)
+    cfg = BiscottiConfig(
+        num_nodes=n_dev, dataset="creditcard", base_port=25510,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=True,
+        defense=Defense.NONE, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    stepper, agents, results = asyncio.run(run_cluster(cfg, mesh, 2))
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps), "chain-equality oracle violated"
+    lines = dumps[0].splitlines()
+    assert len(lines) == 3
+    assert "ndeltas=0" not in lines[1], dumps[0]
+    # the data plane really ran on the mesh: one sharded batch per round,
+    # not one XLA call per peer
+    assert 1 <= stepper.batches <= 3
+
+
+def test_device_cluster_with_secure_agg():
+    mesh = _mesh()
+    n_dev = math.prod(mesh.devices.shape)
+    cfg = BiscottiConfig(
+        num_nodes=n_dev, dataset="creditcard", base_port=25520,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=True, noising=True, verification=True,
+        defense=Defense.NONE, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    stepper, agents, results = asyncio.run(run_cluster(cfg, mesh, 2))
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+    assert "ndeltas=0" not in dumps[0].splitlines()[1]
+    assert stepper.batches >= 1

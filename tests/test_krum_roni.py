@@ -93,3 +93,36 @@ def test_roni_accepts_good_rejects_bad():
     scores = np.asarray(roni_scores(m, w, deltas, x, y))
     assert mask[0] and not mask[1]
     assert scores[1] > scores[0]
+
+
+# ------------------------------------------------------------- LSH sieve
+
+
+def test_lsh_sieve_attenuates_sybil_duplicates():
+    # 6 well-separated honest updates + 5 copies of one attacker update:
+    # the sybil cluster must collapse to ~one update's worth of influence
+    # (ref: ML/code/logistic_aggregator.py down-weights by neighbor count)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from biscotti_tpu.ops.lsh_sieve import lsh_sieve_aggregate, lsh_sieve_weights
+
+    rng = np.random.RandomState(0)
+    honest = rng.randn(6, 32).astype(np.float32) * 2.0
+    attack = np.tile(rng.randn(1, 32).astype(np.float32) * 2.0, (5, 1))
+    attack += 1e-4 * rng.randn(5, 32).astype(np.float32)  # near-duplicates
+    deltas = jnp.asarray(np.vstack([honest, attack]))
+    key = jax.random.PRNGKey(7)
+
+    w = np.asarray(lsh_sieve_weights(deltas, key))
+    assert np.all(w[6:] <= 1.0 / 4), f"sybil weights not attenuated: {w}"
+    assert np.all(w[:6] >= 0.5), f"honest updates over-attenuated: {w}"
+
+    agg = np.asarray(lsh_sieve_aggregate(deltas, key))
+    naive = np.asarray(deltas).sum(axis=0)
+    expected = honest.sum(axis=0) + attack[0] * float(w[6:].sum())
+    assert np.allclose(agg, expected, atol=1e-2)
+    # the sybil direction's influence shrank ~5x vs naive summation
+    sybil_dir = attack[0] / np.linalg.norm(attack[0])
+    assert abs(agg @ sybil_dir) < abs(naive @ sybil_dir)

@@ -94,3 +94,42 @@ def test_share_magnitude_within_float64_exact_range():
     # |x|<=10, degree 9 must stay below 2^53 so the f64 lstsq is faithful
     worst = sum(100 * 10**4 * 10**j for j in range(10))
     assert worst < 2**53
+
+
+def test_sharded_chunk_axis_matches_unsharded():
+    # SURVEY §5.7: share tensors shard over the chunk axis with no
+    # collectives — results must be bit-identical to the single-device path
+    import numpy as np
+
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        import pytest
+
+        pytest.skip("needs the multi-device CPU mesh")
+    mesh = jax.sharding.Mesh(np.array(devices), ("chunks",))
+    n_dev = len(devices)
+
+    d = 10 * n_dev * 2  # C = 2·n_dev chunks
+    q = jnp.asarray(np.random.RandomState(0).randint(-10**4, 10**4, d),
+                    jnp.int64)
+    total = 20
+    make_sh, agg_sh, recover_sh = ss.make_sharded_share_fns(
+        mesh, total_shares=total)
+
+    coeffs = ss.to_chunks(q)
+    shares_sh = np.asarray(make_sh(coeffs))
+    shares_ref = np.asarray(ss.make_shares(q, total_shares=total))
+    assert np.array_equal(shares_sh, shares_ref)
+
+    stack = jnp.stack([jnp.asarray(shares_ref)] * 3)
+    agg = np.asarray(agg_sh(stack))
+    assert np.array_equal(agg, 3 * shares_ref)
+
+    rec = np.asarray(recover_sh(jnp.asarray(agg),
+                                ss.share_xs(total)))
+    ref = np.asarray(ss.recover_coeffs(jnp.asarray(agg),
+                                       ss.share_xs(total)))
+    assert np.array_equal(rec, ref)
+    assert np.array_equal(ss.from_chunks(jnp.asarray(rec), d), 3 * np.asarray(q))
