@@ -79,6 +79,16 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         lib.ed25519_load_xy_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.ed25519_vss_rlc.restype = ctypes.c_int
+        lib.ed25519_vss_rlc.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.ed25519_msm_signed.restype = ctypes.c_int
+        lib.ed25519_msm_signed.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_char_p,
+        ]
         if not _selfcheck(lib):
             return None
         return lib
@@ -169,16 +179,63 @@ def load_xy_batch(xy: bytes, n: int) -> Optional[bytes]:
     return out.raw
 
 
+def vss_rlc(xs: Sequence[int], gammas: Sequence[int], c_chunks: int,
+            k: int) -> List[int]:
+    """Accumulate Σ_r γ_{r,c}·x_r^j per (c, j) — the RLC coefficient hot
+    loop of VSS verification. γ must be < 2¹²⁸ (split into 64-bit halves
+    internally); returns C·k UNREDUCED signed integers."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    s = len(xs)
+    if len(gammas) != s * c_chunks:
+        raise ValueError("gamma count mismatch")
+    import struct
+
+    xbuf = struct.pack(f"<{s}q", *[int(x) for x in xs])
+    gbuf = bytearray()
+    for g in gammas:
+        g = int(g)
+        if g >> 128:
+            raise ValueError("gamma exceeds 128 bits")
+        gbuf += struct.pack("<QQ", g & ((1 << 64) - 1), g >> 64)
+    out = ctypes.create_string_buffer(32 * c_chunks * k)
+    rc = lib.ed25519_vss_rlc(xbuf, bytes(gbuf), s, c_chunks, k, out)
+    if rc != 0:
+        raise RuntimeError(f"native vss_rlc failed: {rc}")
+    res: List[int] = []
+    raw = out.raw
+    for i in range(c_chunks * k):
+        lo = int.from_bytes(raw[32 * i: 32 * i + 16], "little", signed=True)
+        hi = int.from_bytes(raw[32 * i + 16: 32 * i + 32], "little",
+                            signed=True)
+        res.append(lo + (hi << 64))
+    return res
+
+
 def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
     """MSM over an already-validated 128B/point buffer (from
-    load_xy_batch) — skips the per-point python int marshalling."""
+    load_xy_batch) — skips the per-point python int marshalling.
+
+    Scalars may be SIGNED and UNREDUCED (|s| < 2²⁵⁶): short magnitudes keep
+    Pippenger's window count down (a mod-q-reduced scalar is dense 252-bit
+    even when the underlying combination is ~180-bit), and signs ride a
+    separate byte map with on-the-fly point negation in C++."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
     if len(points_buf) != 128 * n or len(scalars) != n:
         raise ValueError("buffer length mismatch")
-    sbuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in scalars)
+    sbuf = bytearray()
+    signs = bytearray(n)
+    for i, s in enumerate(scalars):
+        s = int(s)
+        if s < 0:
+            signs[i] = 1
+            s = -s
+        if s >> 256:
+            s %= ed.Q
+        sbuf += s.to_bytes(32, "little")
     out = ctypes.create_string_buffer(64)
-    rc = lib.ed25519_msm(sbuf, points_buf, n, out)
+    rc = lib.ed25519_msm_signed(bytes(sbuf), bytes(signs), points_buf, n, out)
     if rc != 0:
         raise RuntimeError(f"native msm failed: {rc}")
     x = int.from_bytes(out.raw[:32], "little")

@@ -146,7 +146,8 @@ class BatchStepper:
 
 
 async def run_cluster(cfg_base, mesh, iterations: int, log_dir: str = ""):
-    """Boot N agents sharing one BatchStepper; returns (agents, results)."""
+    """Boot N agents sharing one BatchStepper; returns
+    (stepper, agents, results)."""
     import os
 
     from biscotti_tpu.runtime.peer import PeerAgent

@@ -90,6 +90,24 @@ def main(argv=None) -> int:
     write_peers_file(hosts, args.nodes_per_host, args.base_port,
                      args.peers_file)
 
+    # distribute the bootstrap artifacts to every remote host (the
+    # reference scp's peersFileSent + keys to each VM, runBiscotti.sh)
+    remote_hosts = sorted({h for h in hosts if h != "localhost"})
+    for h in remote_hosts:
+        copies = [(args.peers_file, args.peers_file, [])]
+        if args.key_dir:
+            copies.append((args.key_dir, args.key_dir, ["-r"]))
+        for src, dst, flags in copies:
+            scp = ["scp", "-q", *flags, src, f"{h}:{dst}"]
+            if args.dry_run:
+                print(f"[scp]   {' '.join(shlex.quote(c) for c in scp)}")
+                continue
+            rc = subprocess.run(scp).returncode
+            if rc != 0:
+                print(f"[pod] scp of {src} to {h} failed ({rc})",
+                      file=sys.stderr)
+                return 2
+
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")

@@ -270,13 +270,15 @@ const Consts &consts() {
 
 extern "C" {
 
-// Pippenger bucket MSM. scalars: n×32 bytes LE (already reduced mod group
-// order by the caller); points: n×128 bytes (X,Y,Z,T as 32-byte LE field
-// elements); out: 64 bytes affine (x, y).
-int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
-                uint8_t *out) {
+namespace {
+
+// shared Pippenger core; signs may be null (all positive). Window width
+// adapts to n — at bucket-MSM scale (10⁵+ points from batched VSS
+// verification) wider windows cut the add count severalfold versus the
+// fixed 8-bit window that suits commitment-sized inputs.
+int msm_core(const uint8_t *scalars, const uint8_t *signs,
+             const uint8_t *points, size_t n, uint8_t *out) {
   if (n == 0) {
-    // identity: x=0, y=1
     memset(out, 0, 64);
     out[32] = 1;
     return 0;
@@ -288,8 +290,11 @@ int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
     pts[i].Y = fe_frombytes(p + 32);
     pts[i].Z = fe_frombytes(p + 64);
     pts[i].T = fe_frombytes(p + 96);
+    if (signs && signs[i]) {  // negate: (-X, Y, Z, -T)
+      pts[i].X = fe_sub(fe_zero(), pts[i].X);
+      pts[i].T = fe_sub(fe_zero(), pts[i].T);
+    }
   }
-  // find highest set bit across scalars
   int maxbit = -1;
   for (size_t i = 0; i < n; i++) {
     for (int byte = 31; byte >= 0; byte--) {
@@ -309,7 +314,10 @@ int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
     return 0;
   }
 
-  const int C = n >= 32 ? 8 : 4;  // window bits
+  int C = 4;
+  for (size_t m = n; m >= 32; m >>= 1) C++;  // ≈ log2(n) - 1
+  if (C > 16) C = 16;
+  if (C < 4) C = 4;
   const int nwin = (maxbit + C) / C;
   std::vector<ge> buckets((size_t(1) << C));
   ge acc = ge_identity();
@@ -318,7 +326,6 @@ int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
   for (int w = nwin - 1; w >= 0; w--) {
     if (acc_set)
       for (int k = 0; k < C; k++) acc = ge_double(acc);
-    for (auto &b : buckets) b = ge_identity();
     std::vector<bool> used(buckets.size(), false);
     for (size_t i = 0; i < n; i++) {
       int bitpos = w * C;
@@ -355,13 +362,31 @@ int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
   }
   if (!acc_set) acc = ge_identity();
 
-  // affine: x = X/Z, y = Y/Z
   fe zinv = fe_invert(acc.Z);
   fe x = fe_mul(acc.X, zinv);
   fe y = fe_mul(acc.Y, zinv);
   fe_tobytes(out, x);
   fe_tobytes(out + 32, y);
   return 0;
+}
+
+}  // namespace
+
+// Pippenger bucket MSM. scalars: n×32 bytes LE (already reduced mod group
+// order by the caller); points: n×128 bytes (X,Y,Z,T as 32-byte LE field
+// elements); out: 64 bytes affine (x, y).
+int ed25519_msm(const uint8_t *scalars, const uint8_t *points, size_t n,
+                uint8_t *out) {
+  return msm_core(scalars, nullptr, points, n, out);
+}
+
+// Signed-magnitude MSM: scalars are |s| (32B LE, NOT reduced mod q —
+// short magnitudes mean fewer Pippenger windows), signs[i] nonzero for
+// negative. Callers with ~180-bit RLC magnitudes skip ~30% of the window
+// passes a mod-q-dense scalar would force.
+int ed25519_msm_signed(const uint8_t *scalars, const uint8_t *signs,
+                       const uint8_t *points, size_t n, uint8_t *out) {
+  return msm_core(scalars, signs, points, n, out);
 }
 
 // Single scalar mult via the same machinery (used by tests / keygen).
@@ -407,6 +432,51 @@ int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
     fe_tobytes(out + i * 128 + 64, one);
     fe t = fe_mul(x, y);
     fe_tobytes(out + i * 128 + 96, t);
+  }
+  return 0;
+}
+
+// VSS random-linear-combination coefficient accumulation — the per-cell
+// inner loop of share verification (biscotti_tpu/crypto/commitments.py
+// vss_verify_multi): for every (row r, chunk c) cell with 128-bit gamma
+// γ_rc and small signed share point x_r, accumulate γ_rc·x_r^j into
+// coeff[c*k + j] for j < k. Python big-ints made this the verify hot spot
+// (~2M small-int ops per mnist round); here γ is split into 64-bit halves
+// and each half accumulated in a signed __int128 — |γ_half·x^j| ≤ 2^108
+// and ≤ S rows sum per cell keeps every accumulator well inside 127 bits.
+// Outputs 2·16-byte little-endian signed accumulators (lo-half, hi-half)
+// per coefficient; the caller combines acc = hi·2^64 + lo and reduces
+// mod q. xs: S signed 64-bit share points; gammas: S·C pairs of 64-bit
+// (lo, hi) halves, row-major over (r, c).
+int ed25519_vss_rlc(const int64_t *xs, const uint64_t *gammas, size_t S,
+                    size_t C, size_t k, uint8_t *out) {
+  typedef __int128 i128;
+  std::vector<i128> acc_lo(C * k, 0), acc_hi(C * k, 0);
+  for (size_t r = 0; r < S; r++) {
+    int64_t x = xs[r];
+    for (size_t c = 0; c < C; c++) {
+      uint64_t g_lo = gammas[2 * (r * C + c)];
+      uint64_t g_hi = gammas[2 * (r * C + c) + 1];
+      i128 xj = 1;
+      size_t base = c * k;
+      for (size_t j = 0; j < k; j++) {
+        acc_lo[base + j] += (i128)g_lo * xj;
+        acc_hi[base + j] += (i128)g_hi * xj;
+        xj *= x;
+      }
+    }
+  }
+  for (size_t i = 0; i < C * k; i++) {
+    i128 v = acc_lo[i];
+    for (int b = 0; b < 16; b++) {
+      out[i * 32 + b] = (uint8_t)(v & 0xFF);
+      v >>= 8;
+    }
+    v = acc_hi[i];
+    for (int b = 0; b < 16; b++) {
+      out[i * 32 + 16 + b] = (uint8_t)(v & 0xFF);
+      v >>= 8;
+    }
   }
   return 0;
 }
