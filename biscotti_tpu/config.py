@@ -110,6 +110,15 @@ class BiscottiConfig:
     stake_unit: int = 5  # STAKE_UNIT (honest.go:46)
     precision: int = 4  # decimal digits kept by quantization (main.go:45)
     poly_size: int = 10  # Shamir chunk degree (main.go:46)
+    # Share-row redundancy factor r: TOTAL_SHARES = ceil(r·k/M)·M. The
+    # reference hardwires r=2 (main.go:825) — generous fault tolerance, but
+    # it lets any ⌈M/2⌉ miners reconstruct an aggregate, so two DISJOINT
+    # miner subsets can serve two different aggregation sets and a
+    # malicious leader can difference them to unmask an individual update.
+    # Any r < 2 makes recovering subsets need > M/2 miners, so every pair
+    # of them overlaps in a miner whose one-set-per-round guard then fires
+    # (see _h_get_miner_part). r=1.5 still tolerates ⌊M/3⌋ dead miners.
+    share_redundancy: float = 2.0  # reference-parity default
     max_iterations: int = 100  # MAX_ITERATIONS (main.go:48)
     fail_prob: float = 0.0  # random per-iteration self-crash (main.go:54-55)
     defense: Defense = Defense.KRUM  # POISON_DEFENSE (main.go:57)
@@ -155,8 +164,35 @@ class BiscottiConfig:
 
     @property
     def total_shares(self) -> int:
-        """TOTAL_SHARES = ceil(2·POLY_SIZE/NUM_MINERS)·NUM_MINERS (ref: main.go:825)."""
-        return int(math.ceil(2.0 * self.poly_size / self.num_miners)) * self.num_miners
+        """TOTAL_SHARES = ceil(r·POLY_SIZE/NUM_MINERS)·NUM_MINERS
+        (ref: main.go:825 with r fixed at 2; see share_redundancy).
+
+        Exact rational arithmetic — float ceil would let representation
+        error round rows-per-miner up and silently reopen the differencing
+        channel the knob exists to close. When r < 2 is configured, the
+        property it promises (no ⌊M/2⌋-miner subset can reconstruct) is
+        CHECKED against the rounded layout and misconfigurations fail
+        loudly instead of silently not delivering the guarantee."""
+        from fractions import Fraction
+
+        if self.share_redundancy < 1.0:
+            raise ValueError("share_redundancy < 1 leaves fewer rows than "
+                             "polynomial coefficients: recovery impossible")
+        r = Fraction(self.share_redundancy).limit_denominator(1_000_000)
+        per = -((-r * self.poly_size) // self.num_miners)  # exact ceil
+        per = max(int(per), 1)
+        t = per * self.num_miners
+        if self.share_redundancy < 2.0:
+            half = self.num_miners // 2
+            if per * half >= self.poly_size:
+                raise ValueError(
+                    f"share_redundancy={self.share_redundancy} with "
+                    f"poly_size={self.poly_size}, num_miners="
+                    f"{self.num_miners} rounds to {per} rows/miner, so "
+                    f"{half} miners still hold ≥ poly_size rows and the "
+                    "r<2 anti-differencing guarantee does NOT hold — "
+                    "lower r, raise poly_size, or use fewer miners")
+        return t
 
     @property
     def shares_per_miner(self) -> int:

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from biscotti_tpu.crypto import ed25519 as ed
 
@@ -79,15 +79,16 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         lib.ed25519_load_xy_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
-        lib.ed25519_vss_rlc.restype = ctypes.c_int
-        lib.ed25519_vss_rlc.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
-        ]
         lib.ed25519_msm_signed.restype = ctypes.c_int
         lib.ed25519_msm_signed.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.ed25519_vss_rlc_scalars.restype = ctypes.c_int
+        lib.ed25519_vss_rlc_scalars.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_char_p,
         ]
         if not _selfcheck(lib):
             return None
@@ -101,18 +102,17 @@ def _load() -> Optional[ctypes.CDLL]:
     if _load_attempted:
         return _lib
     _load_attempted = True
-    if not any(os.path.exists(os.path.abspath(p)) for p in _LIB_PATHS):
-        _build()
+    # always let make run: it is a no-op when the .so is current, and it
+    # refreshes a stale binary whose exported symbols predate the sources
+    # (which would otherwise silently drop all native acceleration)
+    _build()
     for path in _LIB_PATHS:
         full = os.path.abspath(path)
         if not os.path.exists(full):
             continue
         lib = _try_load(full)
         if lib is None:
-            # a stale binary (missing symbols / failed self-check): rebuild
-            # from source once and retry — make's dependency tracking
-            # refreshes the .so when the .cpp is newer
-            _build()
+            _build()  # one retry in case the first build raced/failed
             lib = _try_load(full)
         if lib is not None:
             _lib = lib
@@ -179,37 +179,44 @@ def load_xy_batch(xy: bytes, n: int) -> Optional[bytes]:
     return out.raw
 
 
-def vss_rlc(xs: Sequence[int], gammas: Sequence[int], c_chunks: int,
-            k: int) -> List[int]:
-    """Accumulate Σ_r γ_{r,c}·x_r^j per (c, j) — the RLC coefficient hot
-    loop of VSS verification. γ must be < 2¹²⁸ (split into 64-bit halves
-    internally); returns C·k UNREDUCED signed integers."""
+def vss_rlc_scalars(xs: Sequence[int], gammas_buf: bytes, c_chunks: int,
+                    k: int) -> Tuple[bytes, bytes]:
+    """Fused RLC → MSM-ready buffers: returns (scalars 32B·C·k magnitudes
+    with cofactor 8 folded in, signs C·k bytes) consumable directly by
+    msm_signed_raw. gammas_buf: S·C packed (lo u64, hi u64) pairs."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
     s = len(xs)
-    if len(gammas) != s * c_chunks:
-        raise ValueError("gamma count mismatch")
+    if len(gammas_buf) != 16 * s * c_chunks:
+        raise ValueError("gamma buffer length mismatch")
     import struct
 
     xbuf = struct.pack(f"<{s}q", *[int(x) for x in xs])
-    gbuf = bytearray()
-    for g in gammas:
-        g = int(g)
-        if g >> 128:
-            raise ValueError("gamma exceeds 128 bits")
-        gbuf += struct.pack("<QQ", g & ((1 << 64) - 1), g >> 64)
-    out = ctypes.create_string_buffer(32 * c_chunks * k)
-    rc = lib.ed25519_vss_rlc(xbuf, bytes(gbuf), s, c_chunks, k, out)
+    out_s = ctypes.create_string_buffer(32 * c_chunks * k)
+    out_sign = ctypes.create_string_buffer(c_chunks * k)
+    rc = lib.ed25519_vss_rlc_scalars(xbuf, gammas_buf, s, c_chunks, k,
+                                     out_s, out_sign)
     if rc != 0:
-        raise RuntimeError(f"native vss_rlc failed: {rc}")
-    res: List[int] = []
-    raw = out.raw
-    for i in range(c_chunks * k):
-        lo = int.from_bytes(raw[32 * i: 32 * i + 16], "little", signed=True)
-        hi = int.from_bytes(raw[32 * i + 16: 32 * i + 32], "little",
-                            signed=True)
-        res.append(lo + (hi << 64))
-    return res
+        raise RuntimeError(f"native vss_rlc_scalars failed: {rc}")
+    return out_s.raw, out_sign.raw
+
+
+def msm_signed_raw(scalars_buf: bytes, signs_buf: bytes,
+                   points_buf: bytes, n: int) -> ed.Point:
+    """MSM over pre-packed (magnitude, sign, point) buffers — zero python
+    marshalling on the hot path."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if (len(points_buf) != 128 * n or len(scalars_buf) != 32 * n
+            or len(signs_buf) != n):
+        raise ValueError("buffer length mismatch")
+    out = ctypes.create_string_buffer(64)
+    rc = lib.ed25519_msm_signed(scalars_buf, signs_buf, points_buf, n, out)
+    if rc != 0:
+        raise RuntimeError(f"native msm failed: {rc}")
+    x = int.from_bytes(out.raw[:32], "little")
+    y = int.from_bytes(out.raw[32:], "little")
+    return (x, y, 1, (x * y) % ed.P)
 
 
 def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:

@@ -448,7 +448,8 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
 
     s_tot = 0
     t_tot = 0
-    all_scalars: List[int] = []
+    all_scalars: List[int] = []  # python fallback path
+    native_bufs: List[Tuple[bytes, bytes]] = []  # (magnitudes, signs)
     all_pts: List[ed.Point] = []
     all_bufs: List[bytes] = []
     gi = 0
@@ -473,17 +474,21 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
         # linear in γ). The per-cell k-power chain — ~2M small-int ops per
         # mnist round — runs in C++ when the native library is loaded.
         rows = np.asarray(rows)
-        gammas = [
-            int.from_bytes(entropy[16 * (gi + i): 16 * (gi + i + 1)],
-                           "little") | 1
-            for i in range(len(xs) * c_chunks)
-        ]
-        gi += len(xs) * c_chunks
+        cells = len(xs) * c_chunks
+        # gamma_i = entropy 16-byte window with the low bit forced — as an
+        # int for the python s/t accumulation, and verbatim as the packed
+        # (lo u64, hi u64) little-endian pair the native RLC consumes
+        gam_bytes = bytearray(entropy[16 * gi: 16 * (gi + cells)])
+        for i in range(0, len(gam_bytes), 16):
+            gam_bytes[i] |= 1
+        gam_bytes = bytes(gam_bytes)
+        gi += cells
         blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
         cell = 0
         for r, x in enumerate(xs):
             for ci in range(c_chunks):
-                g = gammas[cell]
+                g = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
+                                   "little")
                 cell += 1
                 s_tot += g * int(rows[r, ci])
                 off = 32 * (r * c_chunks + ci)
@@ -492,32 +497,34 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
                     return False
                 t_tot += g * t_val
         if native is not None:
-            coeff = native.vss_rlc(list(xs), gammas, c_chunks, k)
+            # fused native path: RLC power chains → MSM-ready signed
+            # magnitude buffers (cofactor folded in C++), zero python
+            # bignum traffic for the per-point scalars
+            sb, sgn = native.vss_rlc_scalars(list(xs), gam_bytes,
+                                             c_chunks, k)
+            native_bufs.append((sb, sgn))
         else:
             coeff = [0] * (c_chunks * k)
             cell = 0
             for r, x in enumerate(xs):
                 xi = int(x)
                 for ci in range(c_chunks):
-                    xj = gammas[cell]
+                    xj = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
+                                        "little")
                     cell += 1
                     base = ci * k
                     for j in range(k):
                         coeff[base + j] += xj
                         xj *= xi
-        if native is not None:
-            # keep magnitudes UNREDUCED (~180-bit): the signed-scalar MSM
-            # handles them directly with fewer Pippenger windows than the
-            # mod-q-dense equivalents
-            all_scalars.extend(8 * v for v in coeff)
-        else:
             all_scalars.extend((8 * v) % _Q for v in coeff)
 
     lhs = ed.point_add(ed.base_mult((8 * s_tot) % _Q),
                        ed.scalar_mult((8 * t_tot) % _Q, H_POINT))
     if native is not None:
-        rhs = native.msm_raw(all_scalars, b"".join(all_bufs),
-                             len(all_scalars))
+        sbuf = b"".join(sb for sb, _ in native_bufs)
+        signs = b"".join(sgn for _, sgn in native_bufs)
+        rhs = native.msm_signed_raw(sbuf, signs, b"".join(all_bufs),
+                                    len(signs))
     else:
         rhs = msm(all_scalars, all_pts)
     return ed.point_equal(lhs, rhs)

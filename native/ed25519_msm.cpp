@@ -436,20 +436,21 @@ int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
   return 0;
 }
 
-// VSS random-linear-combination coefficient accumulation — the per-cell
-// inner loop of share verification (biscotti_tpu/crypto/commitments.py
-// vss_verify_multi): for every (row r, chunk c) cell with 128-bit gamma
-// γ_rc and small signed share point x_r, accumulate γ_rc·x_r^j into
-// coeff[c*k + j] for j < k. Python big-ints made this the verify hot spot
-// (~2M small-int ops per mnist round); here γ is split into 64-bit halves
-// and each half accumulated in a signed __int128 — |γ_half·x^j| ≤ 2^108
-// and ≤ S rows sum per cell keeps every accumulator well inside 127 bits.
-// Outputs 2·16-byte little-endian signed accumulators (lo-half, hi-half)
-// per coefficient; the caller combines acc = hi·2^64 + lo and reduces
-// mod q. xs: S signed 64-bit share points; gammas: S·C pairs of 64-bit
-// (lo, hi) halves, row-major over (r, c).
-int ed25519_vss_rlc(const int64_t *xs, const uint64_t *gammas, size_t S,
-                    size_t C, size_t k, uint8_t *out) {
+// VSS random-linear-combination accumulation, emitting MSM-READY buffers
+// (the per-cell inner loop of share verification, see
+// biscotti_tpu/crypto/commitments.py vss_verify_multi): for every (row r,
+// chunk c) cell with 128-bit gamma and small signed share point x_r,
+// accumulate gamma*x_r^j into coefficient (c, j); gamma is split into
+// 64-bit halves, each accumulated in a signed __int128 (|gamma_half*x^j|
+// <= 2^108, <= S rows summed stays inside 127 bits). Emits per coefficient a
+// 32-byte little-endian |8·acc| magnitude plus a sign byte — exactly the
+// (scalars, signs) input of ed25519_msm_signed, so the caller never
+// touches the accumulators as bignums. |8·acc| ≤ 2^116 per gamma half
+// pair recombined: acc = hi·2^64 + lo with |acc| ≤ 2^113, ×8 ≤ 2^116 —
+// comfortably inside 32 bytes.
+int ed25519_vss_rlc_scalars(const int64_t *xs, const uint64_t *gammas,
+                            size_t S, size_t C, size_t k,
+                            uint8_t *out_scalars, uint8_t *out_signs) {
   typedef __int128 i128;
   std::vector<i128> acc_lo(C * k, 0), acc_hi(C * k, 0);
   for (size_t r = 0; r < S; r++) {
@@ -467,16 +468,36 @@ int ed25519_vss_rlc(const int64_t *xs, const uint64_t *gammas, size_t S,
     }
   }
   for (size_t i = 0; i < C * k; i++) {
-    i128 v = acc_lo[i];
-    for (int b = 0; b < 16; b++) {
-      out[i * 32 + b] = (uint8_t)(v & 0xFF);
-      v >>= 8;
+    // v = 8·(acc_hi·2^64 + acc_lo), |acc_*| ≤ 2^113 so 8·acc fits i128.
+    // Decompose v = upper·2^64 + low64 with 0 ≤ low64 < 2^64 using
+    // arithmetic shift (floor division): lo = (lo asr 64)·2^64 + (u64)lo
+    // holds exactly for any signed lo. Then sign(v) = sign(upper).
+    i128 lo = acc_lo[i] * 8;
+    i128 hi = acc_hi[i] * 8;
+    i128 upper = hi + (lo >> 64);
+    uint64_t low64 = (uint64_t)lo;
+    bool neg = upper < 0;
+    unsigned __int128 mag_hi;
+    uint64_t mag_lo;
+    if (neg) {
+      // −v = (−upper)·2^64 − low64
+      unsigned __int128 mu = (unsigned __int128)(-upper);
+      if (low64 == 0) {
+        mag_hi = mu;
+        mag_lo = 0;
+      } else {
+        mag_hi = mu - 1;
+        mag_lo = (uint64_t)(0 - low64);
+      }
+    } else {
+      mag_hi = (unsigned __int128)upper;
+      mag_lo = low64;
     }
-    v = acc_hi[i];
-    for (int b = 0; b < 16; b++) {
-      out[i * 32 + 16 + b] = (uint8_t)(v & 0xFF);
-      v >>= 8;
-    }
+    uint8_t *o = out_scalars + i * 32;
+    memset(o, 0, 32);
+    for (int b = 0; b < 8; b++) o[b] = (uint8_t)(mag_lo >> (8 * b));
+    for (int b = 0; b < 16; b++) o[8 + b] = (uint8_t)(mag_hi >> (8 * b));
+    out_signs[i] = neg ? 1 : 0;
   }
   return 0;
 }

@@ -27,8 +27,9 @@ int ed25519_batch_commit(const uint8_t *a, const uint8_t *b,
                          const uint8_t *g, const uint8_t *h, size_t n,
                          uint8_t *out);
 int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out);
-int ed25519_vss_rlc(const int64_t *xs, const uint64_t *gammas, size_t S,
-                    size_t C, size_t k, uint8_t *out);
+int ed25519_vss_rlc_scalars(const int64_t *xs, const uint64_t *gammas,
+                            size_t S, size_t C, size_t k,
+                            uint8_t *out_scalars, uint8_t *out_signs);
 }
 
 namespace {
@@ -103,12 +104,15 @@ void test_group_identities() {
   badxy[0] ^= 1;
   check(ed25519_load_xy_batch(badxy, 1, loaded) != 0, "off-curve rejected");
 
-  // vss_rlc: gammas=1 (lo=1,hi=0), one row x=2 → coeff_j = 2^j
-  int64_t xs[1] = {2};
+  // vss_rlc_scalars: gamma=1 (lo=1,hi=0), one row x=−2 → coeff_j =
+  // 8·(−2)^j with alternating sign (cofactor 8 folded in)
+  int64_t xs[1] = {-2};
   uint64_t gam[2] = {1, 0};
-  uint8_t rlc[3 * 32];
-  check(ed25519_vss_rlc(xs, gam, 1, 1, 3, rlc) == 0, "rlc runs");
-  check(rlc[0] == 1 && rlc[32] == 2 && rlc[64] == 4, "rlc powers");
+  uint8_t rlc[3 * 32], signs3[3];
+  check(ed25519_vss_rlc_scalars(xs, gam, 1, 1, 3, rlc, signs3) == 0,
+        "rlc runs");
+  check(rlc[0] == 8 && rlc[32] == 16 && rlc[64] == 32, "rlc magnitudes");
+  check(signs3[0] == 0 && signs3[1] == 1 && signs3[2] == 0, "rlc signs");
 }
 
 void hammer_thread() {

@@ -304,3 +304,28 @@ def test_honest_secureagg_cluster_still_accepts_everyone():
     stake = chain.latest_stake_map()
     assert all(v >= agents[0].cfg.default_stake for v in stake.values())
     assert sum(a.counters.get("submission_rejected", 0) for a in agents) == 0
+
+
+def test_reduced_redundancy_closes_differencing_and_still_converges():
+    # share_redundancy < 2 forces any recovering miner subset past M/2, so
+    # two disjoint subsets cannot both reconstruct and the per-miner
+    # one-set guard covers every pair; the protocol must still converge
+    n, port = 6, 25100
+    cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
+                 num_miners=3, defense=Defense.NONE, max_iterations=1,
+                 share_redundancy=1.5) for i in range(n)]
+    assert cfgs[0].total_shares == 15  # ceil(1.5*10/3)*3
+    # structural property: rows/miner * floor(M/2) < poly_size
+    assert cfgs[0].shares_per_miner * (cfgs[0].num_miners // 2) \
+        < cfgs[0].poly_size
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    results, agents = asyncio.run(go())
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+    assert any("ndeltas=" in ln and "ndeltas=0" not in ln
+               for ln in dumps[0].splitlines()[1:]), dumps[0]

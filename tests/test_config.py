@@ -46,3 +46,22 @@ def test_cli_percentage_normalisation():
     ns = p.parse_args(["-t", "100", "-ns", "70", "-sa", "0"])
     c = BiscottiConfig.from_args(ns)
     assert c.sample_percent == 0.70 and not c.secure_agg
+
+
+def test_share_redundancy_guarantee_is_validated():
+    # r < 2 promises no floor(M/2)-miner subset can reconstruct; layouts
+    # where ceil-rounding breaks that promise must fail loudly
+    import pytest
+
+    from biscotti_tpu.config import BiscottiConfig
+
+    ok = BiscottiConfig(share_redundancy=1.5, num_miners=3)
+    assert ok.total_shares == 15 and ok.shares_per_miner == 5
+    assert ok.shares_per_miner * (ok.num_miners // 2) < ok.poly_size
+
+    with pytest.raises(ValueError, match="anti-differencing"):
+        _ = BiscottiConfig(share_redundancy=1.9, num_miners=10).total_shares
+    with pytest.raises(ValueError, match="recovery impossible"):
+        _ = BiscottiConfig(share_redundancy=0.5, num_miners=3).total_shares
+    # the reference-parity default is unchanged
+    assert BiscottiConfig(num_miners=3).total_shares == 21
