@@ -183,8 +183,9 @@ def main():
             dataset="mnist", num_nodes=100, secure_agg=True, noising=True,
             verification=True, defense=Defense.KRUM, **base)),
         ("cifar_lenet_100_krum_secagg", BiscottiConfig(
-            dataset="cifar", num_nodes=100, secure_agg=True, noising=False,
-            verification=True, defense=Defense.KRUM, **base)),
+            dataset="cifar", model_name="cifar_cnn", num_nodes=100,
+            secure_agg=True, noising=False, verification=True,
+            defense=Defense.KRUM, **base)),
     ]
 
     rows = {}

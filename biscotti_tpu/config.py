@@ -74,6 +74,11 @@ class BiscottiConfig:
     node_id: int = 0
     num_nodes: int = 10
     dataset: str = "creditcard"
+    # model-zoo override: "" picks the dataset's default entry (softmax for
+    # image sets, logreg for creditcard — the reference's client_obj.init
+    # default); set e.g. "cifar_cnn" / "mnist_cnn" / "svm" for the CNN/SVM
+    # stacks (ref: ML/Pytorch model files)
+    model_name: str = ""
     peers_file: str = ""
     my_ip: str = "127.0.0.1"
     public_ip: str = ""
@@ -223,6 +228,7 @@ class BiscottiConfig:
         p.add_argument("-i", "--node-id", type=int, default=0)
         p.add_argument("-t", "--num-nodes", type=int, default=10)
         p.add_argument("-d", "--dataset", type=str, default="creditcard")
+        p.add_argument("--model", dest="model_name", type=str, default="")
         p.add_argument("-f", "--peers-file", type=str, default="")
         p.add_argument("-a", "--my-ip", type=str, default="127.0.0.1")
         p.add_argument("-pa", "--public-ip", type=str, default="")
@@ -254,6 +260,7 @@ class BiscottiConfig:
             node_id=ns.node_id,
             num_nodes=ns.num_nodes,
             dataset=ns.dataset,
+            model_name=getattr(ns, "model_name", ""),
             peers_file=ns.peers_file,
             my_ip=ns.my_ip,
             public_ip=ns.public_ip,

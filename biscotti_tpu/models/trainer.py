@@ -121,7 +121,8 @@ class Trainer:
 
         self.cfg = cfg or BiscottiConfig(dataset=dataset)
         self.dataset = dataset
-        self.model = model or model_for_dataset(dataset)
+        self.model = model or model_for_dataset(
+            dataset, getattr(self.cfg, "model_name", ""))
         self.mode = "sgd" if self.model.name == "logreg" else "grad"
         self.batch_size = self.cfg.batch_size
         # Every stream is keyed on (config seed, shard identity) so peers

@@ -97,7 +97,8 @@ class Simulator:
 
     def __init__(self, cfg: BiscottiConfig, model: Optional[Model] = None):
         self.cfg = cfg
-        self.model = model or model_for_dataset(cfg.dataset)
+        self.model = model or model_for_dataset(
+            cfg.dataset, getattr(cfg, "model_name", ""))
         self.mode = "sgd" if self.model.name == "logreg" else "grad"
         self.num_params = self.model.num_params
         n = cfg.num_nodes

@@ -60,7 +60,8 @@ class BatchStepper:
         if n % n_dev != 0:
             raise ValueError(f"num_nodes {n} must divide over {n_dev} devices")
 
-        model = model_for_dataset(cfg.dataset)
+        model = model_for_dataset(cfg.dataset,
+                                  getattr(cfg, "model_name", ""))
         self.num_params = model.num_params
         mode = "sgd" if model.name == "logreg" else "grad"
         step = local_step_fn(model, mode, clip=cfg.grad_clip,
