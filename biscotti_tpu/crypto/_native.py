@@ -90,6 +90,12 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
             ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.c_char_p,
         ]
+        lib.ed25519_vss_st_accum.restype = ctypes.c_int
+        lib.ed25519_vss_st_accum.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_char_p,
+        ]
         if not _selfcheck(lib):
             return None
         return lib
@@ -199,6 +205,26 @@ def vss_rlc_scalars(xs: Sequence[int], gammas_buf: bytes, c_chunks: int,
     if rc != 0:
         raise RuntimeError(f"native vss_rlc_scalars failed: {rc}")
     return out_s.raw, out_sign.raw
+
+
+def vss_st_accum(gammas_buf: bytes, rows_buf: bytes, blinds_buf: bytes,
+                 s: int, c_chunks: int) -> Optional[Tuple[int, int]]:
+    """(Σγ·row, Σγ·t_val) over all S·C cells — the lhs accumulators of the
+    VSS check. Returns None if any blind value is non-canonical (≥ q)."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    cells = s * c_chunks
+    if (len(gammas_buf) != 16 * cells or len(rows_buf) != 8 * cells
+            or len(blinds_buf) != 32 * cells):
+        raise ValueError("buffer length mismatch")
+    out_s = ctypes.create_string_buffer(40)
+    out_t = ctypes.create_string_buffer(56)
+    rc = lib.ed25519_vss_st_accum(gammas_buf, rows_buf, blinds_buf,
+                                  s, c_chunks, out_s, out_t)
+    if rc != 0:
+        return None
+    return (int.from_bytes(out_s.raw, "little", signed=True),
+            int.from_bytes(out_t.raw, "little"))
 
 
 def msm_signed_raw(scalars_buf: bytes, signs_buf: bytes,

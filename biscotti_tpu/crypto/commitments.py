@@ -484,26 +484,35 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
         gam_bytes = bytes(gam_bytes)
         gi += cells
         blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
-        cell = 0
-        for r, x in enumerate(xs):
-            for ci in range(c_chunks):
-                g = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
-                                   "little")
-                cell += 1
-                s_tot += g * int(rows[r, ci])
-                off = 32 * (r * c_chunks + ci)
-                t_val = int.from_bytes(blind_bytes[off: off + 32], "little")
-                if t_val >= _Q:
-                    return False
-                t_tot += g * t_val
         if native is not None:
-            # fused native path: RLC power chains → MSM-ready signed
-            # magnitude buffers (cofactor folded in C++), zero python
-            # bignum traffic for the per-point scalars
+            # fused native path: lhs accumulators AND RLC power chains →
+            # MSM-ready signed magnitude buffers (cofactor folded in C++);
+            # zero python bignum traffic on the verify hot path
+            st_acc = native.vss_st_accum(
+                gam_bytes,
+                np.ascontiguousarray(rows, dtype=np.int64).tobytes(),
+                blind_bytes, len(xs), c_chunks)
+            if st_acc is None:
+                return False  # non-canonical blind value
+            s_tot += st_acc[0]
+            t_tot += st_acc[1]
             sb, sgn = native.vss_rlc_scalars(list(xs), gam_bytes,
                                              c_chunks, k)
             native_bufs.append((sb, sgn))
         else:
+            cell = 0
+            for r, x in enumerate(xs):
+                for ci in range(c_chunks):
+                    g = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
+                                       "little")
+                    cell += 1
+                    s_tot += g * int(rows[r, ci])
+                    off = 32 * (r * c_chunks + ci)
+                    t_val = int.from_bytes(blind_bytes[off: off + 32],
+                                           "little")
+                    if t_val >= _Q:
+                        return False
+                    t_tot += g * t_val
             coeff = [0] * (c_chunks * k)
             cell = 0
             for r, x in enumerate(xs):

@@ -502,6 +502,90 @@ int ed25519_vss_rlc_scalars(const int64_t *xs, const uint64_t *gammas,
   return 0;
 }
 
+namespace {
+
+// little-endian multi-limb accumulator helpers (two's-complement wrap on
+// the fixed width, so signed totals come out right as long as the true
+// value fits the width — bounds documented at each call site)
+inline void acc_add_at(uint64_t *acc, int n, int pos, uint64_t v) {
+  unsigned __int128 cur = (unsigned __int128)acc[pos] + v;
+  acc[pos] = (uint64_t)cur;
+  uint64_t carry = (uint64_t)(cur >> 64);
+  for (int i = pos + 1; i < n && carry; i++) {
+    cur = (unsigned __int128)acc[i] + carry;
+    acc[i] = (uint64_t)cur;
+    carry = (uint64_t)(cur >> 64);
+  }
+}
+
+inline void acc_sub_at(uint64_t *acc, int n, int pos, uint64_t v) {
+  uint64_t before = acc[pos];
+  acc[pos] = before - v;
+  uint64_t borrow = before < v ? 1 : 0;
+  for (int i = pos + 1; i < n && borrow; i++) {
+    uint64_t b = acc[i];
+    acc[i] = b - 1;
+    borrow = b == 0 ? 1 : 0;
+  }
+}
+
+}  // namespace
+
+// Accumulate the lhs scalars of the VSS check: s_tot = Σ γ_rc·row_rc and
+// t_tot = Σ γ_rc·t_rc over all S·C cells. gammas: packed (lo,hi) u64
+// pairs; rows: int64 row-major [r][c]; blinds: 32-byte little-endian
+// values per cell, each REQUIRED < group order q (reject otherwise —
+// returns 1+cell index). Outputs: s_tot as 40-byte little-endian
+// two's-complement (|Σ| ≤ S·C·2^191 ≈ 2^205 ≪ 2^319) and t_tot as
+// 56-byte little-endian unsigned (≤ S·C·2^381 ≈ 2^394 ≪ 2^448).
+int ed25519_vss_st_accum(const uint64_t *gammas, const int64_t *rows,
+                         const uint8_t *blinds, size_t S, size_t C,
+                         uint8_t *out_s, uint8_t *out_t) {
+  // group order q limbs, little-endian
+  static const uint64_t Q[4] = {0x5812631A5CF5D3EDULL,
+                                0x14DEF9DEA2F79CD6ULL,
+                                0x0000000000000000ULL,
+                                0x1000000000000000ULL};
+  uint64_t s_acc[5] = {0, 0, 0, 0, 0};
+  uint64_t t_acc[7] = {0, 0, 0, 0, 0, 0, 0};
+  size_t cells = S * C;
+  for (size_t i = 0; i < cells; i++) {
+    uint64_t g[2] = {gammas[2 * i], gammas[2 * i + 1]};
+    // s: γ · row (signed)
+    int64_t r = rows[i];
+    uint64_t m = r < 0 ? (uint64_t)(-(unsigned long long)r) : (uint64_t)r;
+    for (int gl = 0; gl < 2; gl++) {
+      unsigned __int128 p = (unsigned __int128)g[gl] * m;
+      if (r < 0) {
+        acc_sub_at(s_acc, 5, gl, (uint64_t)p);
+        acc_sub_at(s_acc, 5, gl + 1, (uint64_t)(p >> 64));
+      } else {
+        acc_add_at(s_acc, 5, gl, (uint64_t)p);
+        acc_add_at(s_acc, 5, gl + 1, (uint64_t)(p >> 64));
+      }
+    }
+    // t: γ · t_val (both non-negative); t_val must be canonical (< q)
+    uint64_t t[4];
+    memcpy(t, blinds + 32 * i, 32);
+    bool lt = false, gt = false;
+    for (int l = 3; l >= 0 && !lt && !gt; l--) {
+      if (t[l] < Q[l]) lt = true;
+      else if (t[l] > Q[l]) gt = true;
+    }
+    if (!lt) return (int)(i + 1);  // t_val ≥ q: non-canonical, refuse
+    for (int gl = 0; gl < 2; gl++) {
+      for (int tl = 0; tl < 4; tl++) {
+        unsigned __int128 p = (unsigned __int128)g[gl] * t[tl];
+        acc_add_at(t_acc, 7, gl + tl, (uint64_t)p);
+        acc_add_at(t_acc, 7, gl + tl + 1, (uint64_t)(p >> 64));
+      }
+    }
+  }
+  memcpy(out_s, s_acc, 40);
+  memcpy(out_t, t_acc, 56);
+  return 0;
+}
+
 // Batch Pedersen commit: out[i] = a[i]·G + b[i]·H for i < n, affine (x,y)
 // 64 bytes each. The worker-side hot spot of verifiable secret sharing —
 // 2·d fixed-base scalar mults per update per round (one commitment per

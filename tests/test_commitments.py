@@ -131,3 +131,51 @@ def test_full_pipeline_commit_share_verify_recover():
     rec = ss.recover_update(agg, ss.share_xs(total), num_params=d)
     expected = np.sum(np.trunc(deltas * 1e4) / 1e4, axis=0)
     assert np.allclose(np.asarray(rec), expected, atol=1e-9)
+
+
+def test_vss_verify_native_and_python_paths_agree(monkeypatch):
+    # differential check: the fused native verify (C++ RLC + lhs
+    # accumulators + signed MSM) and the pure-python fallback must agree
+    # on the same deterministic entropy, for valid input and for every
+    # corruption class
+    import numpy as np
+
+    from biscotti_tpu.crypto import _native
+    from biscotti_tpu.crypto import commitments as cmx
+    from biscotti_tpu.ops import secretshare as ssx
+
+    d, k, total = 64, 10, 20
+    rng = np.random.RandomState(5)
+    q = rng.randint(-10**4, 10**4, d).astype(np.int64)
+    c = ssx.num_chunks(d, k)
+    padded = np.zeros(c * k, np.int64)
+    padded[:d] = q
+    comms, blinds = cmx.vss_commit_chunks(padded.reshape(c, k), b"s" * 32,
+                                          b"ctx")
+    xs = [i - ssx.SHARE_OFFSET for i in range(total)][:7]
+    rows = np.asarray(ssx.make_shares(q, k, total))[:7]
+    br = cmx.vss_blind_rows(blinds, xs)
+
+    cases = {"valid": (comms, xs, rows, br)}
+    bad_rows = rows.copy()
+    bad_rows[3, 1] += 1
+    cases["bad_row"] = (comms, xs, bad_rows, br)
+    bad_blind = br.copy()
+    bad_blind[0, 0, 0] ^= 1
+    cases["bad_blind"] = (comms, xs, rows, bad_blind)
+    noncanon = br.copy()
+    noncanon[2, 2, :] = 255  # ≥ q
+    cases["noncanonical_blind"] = (comms, xs, rows, noncanon)
+
+    entropy = bytes(range(256)) * (16 * len(xs) * c // 256 + 1)
+    assert _native.available()
+    native_res = {name: cmx.vss_verify_multi([inst], entropy=entropy)
+                  for name, inst in cases.items()}
+    monkeypatch.setattr(_native, "available", lambda: False)
+    python_res = {name: cmx.vss_verify_multi([inst], entropy=entropy)
+                  for name, inst in cases.items()}
+    assert native_res == python_res, (native_res, python_res)
+    assert native_res["valid"] is True
+    assert not native_res["bad_row"]
+    assert not native_res["bad_blind"]
+    assert not native_res["noncanonical_blind"]

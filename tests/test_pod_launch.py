@@ -1,0 +1,43 @@
+"""Fleet-launcher unit tests (tools/pod_launch.py — the runBiscotti.sh
+equivalent): peers-file layout, command construction, dry-run planning."""
+
+import json
+
+from biscotti_tpu.tools import pod_launch
+
+
+def test_peers_file_ports_are_globally_unique(tmp_path):
+    hosts = ["localhost", "localhost", "vm-a"]
+    out = tmp_path / "peers.txt"
+    pod_launch.write_peers_file(hosts, 2, 9000, str(out))
+    lines = out.read_text().splitlines()
+    assert lines == [
+        "127.0.0.1:9000", "127.0.0.1:9001",  # host 1
+        "127.0.0.1:9002", "127.0.0.1:9003",  # host 2 (same machine!)
+        "vm-a:9004", "vm-a:9005",
+    ]
+    ports = [ln.rsplit(":", 1)[1] for ln in lines]
+    assert len(set(ports)) == len(ports)
+
+
+def test_dry_run_plans_scp_ssh_and_local(tmp_path, capsys):
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("localhost\nvm-a\n# comment\n")
+    keys = tmp_path / "keys"
+    keys.mkdir()
+    rc = pod_launch.main([
+        "--hosts", str(hosts), "--nodes-per-host", "1",
+        "--dataset", "creditcard", "--iterations", "1",
+        "--key-dir", str(keys),
+        "--peers-file", str(tmp_path / "peers.txt"), "--dry-run",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # artifacts are distributed to the remote host before launch
+    assert "[scp]" in out and "vm-a" in out
+    # one local exec, one ssh exec, binding 0.0.0.0 only on the remote
+    assert "[local]" in out and "-a 127.0.0.1" in out
+    assert "[ssh]" in out and "0.0.0.0" in out
+    summary = json.loads(out.splitlines()[-1])
+    assert summary == {"dry_run": True, "total_nodes": 2, "hosts": 2,
+                       "peers_file": str(tmp_path / "peers.txt")}
