@@ -398,7 +398,13 @@ class PeerAgent:
 
     async def _wait_for_iteration(self, it: int, budget: float = 30.0) -> None:
         """Park a future-iteration message until we catch up
-        (ref: main.go:1211-1214, krum.go:240-243)."""
+        (ref: main.go:1211-1214, krum.go:240-243). Iterations past the
+        run's absolute end are refused IMMEDIATELY — parking them would
+        let one hostile packet pin a handler task for the full budget.
+        Anything inside [0, max_iterations] stays parkable: a peer far
+        behind can legitimately leap there via one chain adoption."""
+        if it > self.cfg.max_iterations:
+            raise RPCError("iteration beyond reachable horizon")
         deadline = time.monotonic() + budget
         while self.iteration < it:
             if time.monotonic() > deadline:

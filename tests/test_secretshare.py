@@ -133,3 +133,53 @@ def test_sharded_chunk_axis_matches_unsharded():
                                        ss.share_xs(total)))
     assert np.array_equal(rec, ref)
     assert np.array_equal(ss.from_chunks(jnp.asarray(rec), d), 3 * np.asarray(q))
+
+
+# ----------------------------------------------------- property-based
+
+
+def test_share_pipeline_roundtrip_property():
+    # property: for ANY quantized vector within the protocol's magnitude
+    # range and ANY miner count, recover(aggregate(shares of P peers))
+    # equals the exact integer sum of the peers' vectors
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=30, deadline=None)
+    @given(
+        d=st.integers(min_value=1, max_value=40),
+        num_miners=st.integers(min_value=1, max_value=5),
+        peers=st.integers(min_value=1, max_value=4),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def check(d, num_miners, peers, seed):
+        import numpy as np
+
+        rng = np.random.RandomState(seed)
+        total = ss.total_shares_for(num_miners)
+        qs = rng.randint(-10**6, 10**6, (peers, d)).astype(np.int64)
+        shares = jnp.stack([ss.make_shares(jnp.asarray(q), total_shares=total)
+                            for q in qs])
+        agg = ss.aggregate_shares(shares)
+        rec = ss.recover_coeffs(agg, ss.share_xs(total))
+        got = np.asarray(ss.from_chunks(rec, d))
+        assert np.array_equal(got, qs.sum(axis=0)), (d, num_miners, peers)
+
+    check()
+
+
+def test_miner_row_slices_partition_the_share_matrix():
+    # property: the per-miner row slices tile [0, total_shares) exactly —
+    # no overlap, no gap — for every miner count
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=50, deadline=None)
+    @given(num_miners=st.integers(min_value=1, max_value=26))
+    def check(num_miners):
+        total = ss.total_shares_for(num_miners)
+        seen = []
+        for m in range(num_miners):
+            sl = ss.miner_rows(total, m, num_miners)
+            seen.extend(range(*sl.indices(total)))
+        assert seen == list(range(total))
+
+    check()
