@@ -4,11 +4,24 @@ Capability parity with the reference's registry (ref: ML/Pytorch/datasets.py:6-5
 — mnist 784/10, lfw 8742/12, cifar 3072/10, creditcard 24/2) and its per-peer
 `.npy` shard loader with an 80/20 train cut (ref: ML/Pytorch/mnist_dataset.py:16-31).
 
-This environment has zero egress, so shards are *synthesized*: each dataset is a
-fixed mixture of Gaussian class clusters drawn from a dataset-specific threefry
-key. Generation is fully deterministic in (dataset, shard_name), so every peer
-process regenerates bit-identical shards — the property the reference gets from
+This environment has zero egress, so the reference-dimension shards (mnist /
+cifar / lfw / creditcard) are *synthesized*: each dataset is a fixed mixture of
+Gaussian class clusters drawn from a dataset-specific threefry key. Generation
+is fully deterministic in (dataset, shard_name), so every peer process
+regenerates bit-identical shards — the property the reference gets from
 shipping `.npy` files, and the chain-equality oracle implicitly relies on.
+
+Two REAL datasets ship alongside them, loaded from scikit-learn's bundled
+(offline) data so accuracy claims are falsifiable on real distributions:
+
+  "digits"  1,797 real 8×8 handwritten digit scans (UCI optical digits,
+            the small real sibling of MNIST) — 64 features, 10 classes
+  "cancer"  569 real tabular diagnostic records (Wisconsin breast cancer) —
+            30 standardized features, 2 classes, the real sibling of the
+            reference's creditcard tabular task
+
+Real shards are disjoint slices of a deterministic dataset-keyed shuffle, so
+they are bit-identical across peer processes exactly like the synthetic ones.
 
 Poisoned shards are the honest shard with source-class labels flipped to the
 target class (1 → 7 for mnist, ref: ML/Pytorch/client.py:163-172; the
@@ -38,6 +51,7 @@ class DatasetSpec:
     attack_source: int = 1  # label-flip source class (1→7 for mnist)
     attack_target: int = 7
     cluster_scale: float = 1.0  # intra-class spread
+    real: bool = False  # backed by a bundled real dataset (see module doc)
 
 
 DATASETS: Dict[str, DatasetSpec] = {
@@ -46,6 +60,11 @@ DATASETS: Dict[str, DatasetSpec] = {
     "lfw": DatasetSpec("lfw", 8742, 12, 200, 1000),
     "creditcard": DatasetSpec("creditcard", 24, 2, 400, 1000,
                               attack_source=0, attack_target=1),
+    # real data (scikit-learn bundled, offline): shard/test sizes chosen so
+    # a 10-peer run consumes the whole corpus with a held-out test pool
+    "digits": DatasetSpec("digits", 64, 10, 140, 397, real=True),
+    "cancer": DatasetSpec("cancer", 30, 2, 40, 169,
+                          attack_source=0, attack_target=1, real=True),
 }
 
 
@@ -96,8 +115,41 @@ def _class_means(dataset: str) -> np.ndarray:
     return (means / np.linalg.norm(means, axis=1, keepdims=True)).astype(np.float32) * 6.0
 
 
+@lru_cache(maxsize=None)
+def _real_corpus(dataset: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Full real corpus, standardized, in a deterministic dataset-keyed
+    shuffle order (identical in every peer process). sklearn's bundled
+    datasets load from files inside the installed package — no network."""
+    from sklearn.datasets import load_breast_cancer, load_digits
+
+    if dataset == "digits":
+        raw = load_digits()
+        x = (raw.data / 16.0).astype(np.float32)  # pixel range 0..16
+    elif dataset == "cancer":
+        raw = load_breast_cancer()
+        x = raw.data.astype(np.float32)
+        x = (x - x.mean(axis=0)) / (x.std(axis=0) + 1e-8)
+    else:
+        raise KeyError(f"no real corpus for dataset {dataset!r}")
+    y = raw.target.astype(np.int32)
+    order = _rng(dataset, "corpus-shuffle").permutation(len(x))
+    return np.ascontiguousarray(x[order]), np.ascontiguousarray(y[order])
+
+
 def _draw(dataset: str, tag: str, n: int) -> Tuple[np.ndarray, np.ndarray]:
     s = _spec(dataset)
+    if s.real:
+        x, y = _real_corpus(dataset)
+        if tag in ("test", "attack"):
+            return x[-s.test_size:], y[-s.test_size:]
+        assert tag.startswith("shard")
+        peer = int(tag[len("shard"):])
+        train_n = len(x) - s.test_size
+        # disjoint slices while the corpus lasts; peers beyond capacity wrap
+        # around (real corpora are small — a 100-peer digits run reuses
+        # slices rather than failing, and the wrap is deterministic)
+        start = (peer * s.shard_size) % max(1, train_n - s.shard_size + 1)
+        return x[start:start + n], y[start:start + n]
     rng = _rng(dataset, tag)
     means = _class_means(dataset)
     y = rng.integers(0, s.n_classes, size=n)

@@ -9,7 +9,8 @@ MAX_ITERATIONS entirely on-device (`Simulator.run_scan`: the whole run is
 one XLA program — the reference needed a 100-process fleet per cell).
 
 Artifacts: eval/results/poison.csv (poison,defense,final_error,attack_rate)
-and poison.json summary.
+and poison.json summary for mnist; any other --dataset (e.g. the REAL
+digits/cancer corpora) writes poison_<dataset>.csv/.json alongside.
 
 Usage: python eval/eval_poison.py [--dataset mnist] [--nodes 100]
            [--rounds 100] [--out eval/results]
@@ -69,8 +70,13 @@ def main(argv=None) -> int:
             rows.append(row)
             print(json.dumps(row))
 
+    from biscotti_tpu.data.datasets import DATASETS
+
     os.makedirs(args.out, exist_ok=True)
-    with open(os.path.join(args.out, "poison.csv"), "w") as f:
+    # mnist keeps the historical bare names; other datasets get a suffix so
+    # real-data runs (digits/cancer) sit alongside the synthetic artifacts
+    stem = "poison" if args.dataset == "mnist" else f"poison_{args.dataset}"
+    with open(os.path.join(args.out, f"{stem}.csv"), "w") as f:
         f.write("poison,defense,final_error,attack_rate,mean_accepted\n")
         for r in rows:
             f.write(f"{r['poison']},{r['defense']},{r['final_error']},"
@@ -79,9 +85,11 @@ def main(argv=None) -> int:
         "experiment": "poison",
         "dataset": args.dataset, "nodes": args.nodes, "rounds": args.rounds,
         "rows": rows,
-        "data_note": "synthetic shards (zero-egress env)",
+        "data_note": ("REAL data (sklearn-bundled corpus)"
+                      if DATASETS[args.dataset].real
+                      else "synthetic shards (zero-egress env)"),
     }
-    with open(os.path.join(args.out, "poison.json"), "w") as f:
+    with open(os.path.join(args.out, f"{stem}.json"), "w") as f:
         json.dump(summary, f, indent=1)
     # the defense must actually defend at the reference's operating point
     k30 = next(r for r in rows

@@ -48,6 +48,34 @@ def test_native_edge_cases():
                           cm._msm_python(s, KEY.points))
 
 
+def test_wide_window_signed_msm_matches_python():
+    """Pin the signed-digit recoding at a realistic size: n large enough
+    that the window chooser leaves its C=4 floor (n=6144 → C≈7), with
+    ~170-bit signed magnitudes like the VSS RLC produces — the regime the
+    48-point tests above never reach (multi-byte scalar_bits extraction,
+    carry-window count, 2^(C-1)-bucket loop)."""
+    rng = random.Random(7)
+    n = 6144
+    reps = n // len(KEY.points) + 1
+    points = (KEY.points * reps)[:n]
+    scalars = [rng.randrange(-(1 << 170), 1 << 170) for _ in range(n)]
+    scalars[0] = 0
+    scalars[1] = (1 << 170) - 1  # maxbit driver
+    assert ed.point_equal(
+        _native.msm(scalars, points),
+        cm._msm_python(scalars, points),
+    )
+    # same check through the signed-magnitude raw buffers (the VSS verify
+    # wire shape: |s| + sign byte, NOT reduced mod q)
+    sbuf = b"".join(abs(s).to_bytes(32, "little") for s in scalars)
+    signs = bytes(1 if s < 0 else 0 for s in scalars)
+    pbuf = b"".join(_native._point_bytes(p) for p in points)
+    assert ed.point_equal(
+        _native.msm_signed_raw(sbuf, signs, pbuf, n),
+        cm._msm_python(scalars, points),
+    )
+
+
 def test_commit_update_uses_native_transparently():
     import numpy as np
 
