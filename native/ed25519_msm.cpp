@@ -61,10 +61,13 @@ inline fe fe_add(const fe &a, const fe &b) {
 
 // Lazy (carry-free) add/sub for values that immediately feed fe_mul/fe_sq:
 // fe_mul tolerates limbs up to ~2^55 (5 products of 2^55·2^60 stay inside
-// u128), and every operand in the group-law chains below is either a
-// normalized fe_mul output (< 2^52) or one lazy result (< 2^54), so
-// skipping the sequential carry ripple here is safe. Subtrahends must be
-// normalized (< 2p per limb) — all call sites satisfy this.
+// u128). INVARIANT for the group-law chains below: lazy chains are at most
+// DEPTH 2 — operands are normalized fe_mul outputs (< 2^52), depth-1 lazy
+// results (< 2^53), or one depth-2 combination of those (< 2^54, e.g.
+// ge_double's f = add_nc(c, g), ge_madd's f/g = sub/add_nc(d, c)). Do not
+// stack a third carry-free level: limbs would approach fe_mul's tolerance
+// and overflow silently. Subtrahends must be normalized (< 2p per limb) —
+// all call sites satisfy this.
 inline fe fe_add_nc(const fe &a, const fe &b) {
   fe r;
   for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
@@ -274,24 +277,36 @@ inline ge ge_msub(const ge &p, const nge &q) {
   return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
 }
 
-// Batch-normalize n extended points to niels form with ONE field inversion
-// (Montgomery's trick). Identity (Z=Y, X=0) yields (1,1,0), which ge_madd
-// treats as a no-op — no special-casing needed downstream.
-void ge_batch_to_niels(const std::vector<ge> &pts, std::vector<nge> &out) {
+// All n points' 1/Z with ONE field inversion (Montgomery's trick) — the
+// single implementation behind both niels conversion and affine
+// serialization.
+void ge_batch_zinv(const std::vector<ge> &pts, std::vector<fe> &zinv) {
   size_t n = pts.size();
-  out.resize(n);
-  std::vector<fe> prefix(n);
+  zinv.resize(n);
   fe run = fe_one();
   for (size_t i = 0; i < n; i++) {
-    prefix[i] = run;
+    zinv[i] = run;  // prefix product so far
     run = fe_mul(run, pts[i].Z);
   }
   fe inv = fe_invert(run);
   for (size_t i = n; i-- > 0;) {
-    fe zinv = fe_mul(inv, prefix[i]);
+    fe prefix = zinv[i];
+    zinv[i] = fe_mul(inv, prefix);
     inv = fe_mul(inv, pts[i].Z);
-    fe x = fe_mul(pts[i].X, zinv);
-    fe y = fe_mul(pts[i].Y, zinv);
+  }
+}
+
+// Batch-normalize n extended points to niels form. Identity (Z=Y, X=0)
+// yields (1,1,0), which ge_madd treats as a no-op — no special-casing
+// needed downstream.
+void ge_batch_to_niels(const std::vector<ge> &pts, std::vector<nge> &out) {
+  size_t n = pts.size();
+  out.resize(n);
+  std::vector<fe> zinv;
+  ge_batch_zinv(pts, zinv);
+  for (size_t i = 0; i < n; i++) {
+    fe x = fe_mul(pts[i].X, zinv[i]);
+    fe y = fe_mul(pts[i].Y, zinv[i]);
     out[i].YpX = fe_add(y, x);
     out[i].YmX = fe_sub(y, x);
     out[i].T2d = fe_mul(fe_mul(x, y), D2);
@@ -461,6 +476,20 @@ int msm_core(const uint8_t *scalars, const uint8_t *signs,
     std::fill(used.begin(), used.end(), false);
     const int32_t *dw = digits.data() + (size_t)w * n;
     for (size_t i = 0; i < n; i++) {
+      // the bucket index 8 iterations ahead is already in the digits
+      // array — prefetch its cache lines so the random bucket-table
+      // access doesn't stall the madd chain (the table exceeds L2 at the
+      // large-n window widths this workload picks)
+      if (i + 8 < n) {
+        int32_t dn = dw[i + 8];
+        if (dn) {
+          const ge *bp = &buckets[(dn > 0 ? dn : -dn) - 1];
+          __builtin_prefetch(bp, 1);
+          __builtin_prefetch(reinterpret_cast<const char *>(bp) + 64, 1);
+          __builtin_prefetch(reinterpret_cast<const char *>(bp) + 128, 1);
+        }
+        __builtin_prefetch(&npts[i + 4]);
+      }
       int32_t d = dw[i];
       if (d > 0) {
         int b = d - 1;
@@ -774,26 +803,25 @@ int ed25519_batch_commit(const uint8_t *a_scalars, const uint8_t *b_scalars,
     ge acc = ge_identity();
     for (int j = 0; j < 32; j++) {
       uint8_t av = a_scalars[i * 32 + j];
-      if (av) acc = ge_madd(acc, comb_g[j * 256 + av]);
       uint8_t bv = b_scalars[i * 32 + j];
+      if (j < 31) {  // next byte's table lines, known one step ahead
+        uint8_t an = a_scalars[i * 32 + j + 1];
+        uint8_t bn = b_scalars[i * 32 + j + 1];
+        if (an) __builtin_prefetch(&comb_g[(j + 1) * 256 + an]);
+        if (bn) __builtin_prefetch(&comb_h[(j + 1) * 256 + bn]);
+      }
+      if (av) acc = ge_madd(acc, comb_g[j * 256 + av]);
       if (bv) acc = ge_madd(acc, comb_h[j * 256 + bv]);
     }
     res[i] = acc;
   }
 
-  // Montgomery batch inversion of all Z's: one fe_invert for the batch
-  std::vector<fe> prefix(n);
-  fe run = fe_one();
+  // serialize affine with one shared batch inversion
+  std::vector<fe> zinv;
+  ge_batch_zinv(res, zinv);
   for (size_t i = 0; i < n; i++) {
-    prefix[i] = run;
-    run = fe_mul(run, res[i].Z);
-  }
-  fe inv = fe_invert(run);
-  for (size_t i = n; i-- > 0;) {
-    fe zinv = fe_mul(inv, prefix[i]);
-    inv = fe_mul(inv, res[i].Z);
-    fe x = fe_mul(res[i].X, zinv);
-    fe y = fe_mul(res[i].Y, zinv);
+    fe x = fe_mul(res[i].X, zinv[i]);
+    fe y = fe_mul(res[i].Y, zinv[i]);
     fe_tobytes(out + i * 64, x);
     fe_tobytes(out + i * 64 + 32, y);
   }
