@@ -96,6 +96,11 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
             ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.c_char_p,
         ]
+        lib.ed25519_vss_blind_rows.restype = ctypes.c_int
+        lib.ed25519_vss_blind_rows.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         if not _selfcheck(lib):
             return None
         return lib
@@ -205,6 +210,26 @@ def vss_rlc_scalars(xs: Sequence[int], gammas_buf: bytes, c_chunks: int,
     if rc != 0:
         raise RuntimeError(f"native vss_rlc_scalars failed: {rc}")
     return out_s.raw, out_sign.raw
+
+
+def vss_blind_rows_raw(blinds_buf: bytes, xs: Sequence[int], c_chunks: int,
+                       k: int) -> Optional[bytes]:
+    """Evaluate all blinding polynomials at all share points mod q.
+    blinds_buf: C·k 32-byte little-endian canonical (< q) coefficients;
+    returns S·C·32 bytes row-major, or None on invalid share points."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(blinds_buf) != 32 * c_chunks * k:
+        raise ValueError("blind buffer length mismatch")
+    import struct
+
+    s = len(xs)
+    xbuf = struct.pack(f"<{s}q", *[int(x) for x in xs])
+    out = ctypes.create_string_buffer(32 * s * c_chunks)
+    rc = lib.ed25519_vss_blind_rows(blinds_buf, xbuf, s, c_chunks, k, out)
+    if rc != 0:
+        return None
+    return out.raw
 
 
 def vss_st_accum(gammas_buf: bytes, rows_buf: bytes, blinds_buf: bytes,

@@ -327,18 +327,23 @@ def vss_commit_chunks(chunks: np.ndarray, seed: bytes,
     [C][k] ints in Z_q). The hot spot is 2·C·k fixed-base mults; the native
     byte-comb path in `native/` takes it when built."""
     c_chunks, k = chunks.shape
+    # all blinding coefficients from ONE SHAKE-256 XOF call (the per-value
+    # sha512 loop this replaces was ~25% of worker commit time at d=7850);
+    # 48-byte windows keep the mod-q bias below 2⁻¹³² so each blind is
+    # statistically uniform in Z_q — the hiding property needs that
+    xof = hashlib.shake_256(seed + b"vss-blind-xof" + context)
+    raw_b = xof.digest(48 * c_chunks * k)
     blinds: List[List[int]] = []
     flat_a: List[int] = []
     flat_b: List[int] = []
+    pos = 0
     for ci in range(c_chunks):
         row = [
-            int.from_bytes(
-                hashlib.sha512(
-                    seed + b"vss-blind" + context
-                    + ci.to_bytes(4, "little") + j.to_bytes(4, "little")
-                ).digest(), "little") % _Q
+            int.from_bytes(raw_b[pos + 48 * j: pos + 48 * (j + 1)],
+                           "little") % _Q
             for j in range(k)
         ]
+        pos += 48 * k
         blinds.append(row)
         flat_a.extend(int(v) for v in chunks[ci])
         flat_b.extend(row)
@@ -390,12 +395,30 @@ def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
     uint8 [S, C, 32] (little-endian Z_q values), the companion tensor to the
     int64 share matrix.
 
-    Horner runs over the SIGNED small x with one reduction at the end: the
-    share points satisfy |x| ≤ S, so the unreduced accumulator stays under
+    The native library evaluates the whole tensor in C (partially-reduced
+    256-bit Horner, ~20× the python loop); the python fallback runs Horner
+    over the SIGNED small x with one reduction at the end: the share
+    points satisfy |x| ≤ S, so the unreduced accumulator stays under
     q·(k·S^k) ≈ 2³⁰⁰ — cheap python-int small-multiplies instead of k
-    full-width modmuls per cell (x mod q is a 252-bit number for negative
-    x, which made the naive version the pipeline's hot spot)."""
+    full-width modmuls per cell."""
     s, c = len(xs), len(blinds)
+    k = len(blinds[0]) if blinds else 0
+    try:
+        from biscotti_tpu.crypto import _native
+
+        native = _native if _native.available() else None
+    except ImportError:
+        native = None
+    if native is not None and c and k and all(len(r) == k for r in blinds):
+        # canonicalize mod q before packing: the C kernel requires < q
+        # inputs, while this public API (like its python fallback below)
+        # accepts arbitrary ints
+        buf = b"".join((int(bj) % _Q).to_bytes(32, "little")
+                       for row in blinds for bj in row)
+        raw = native.vss_blind_rows_raw(buf, [int(x) for x in xs], c, k)
+        if raw is not None:
+            return (np.frombuffer(raw, dtype=np.uint8)
+                    .reshape(s, c, 32).copy())
     out = np.zeros((s, c, 32), dtype=np.uint8)
     for si, x in enumerate(xs):
         xi = int(x)

@@ -5,7 +5,7 @@ CPU (ref: DistSys/kyber.go:456-482 generateMinerSecretShares,
 kyber.go:579-646 createShareAndWitness, kyber.go:712-743 makePolynomialMap)
 and recovers the aggregate with a gonum QR least-squares solve
 (ref: kyber.go:809-867 recoverSecret/Vandermonde). Here the whole pipeline is
-three jitted tensor programs:
+three tensor programs:
 
     shares   = V @ coeffsᵀ        one [S,k]·[k,C] matmul for ALL chunks
     agg      = Σ_peers shares     one sum (psum across miner shards)
@@ -26,37 +26,34 @@ Semantics kept from the reference:
   * per-miner striding: miner m holds share rows [m·S/M, (m+1)·S/M)
     (ref: kyber.go:205-242 extractMinerSecret)
 
-Requires x64 mode (`jax.config.update("jax_enable_x64", True)`) — share
-values reach ~10¹³ for degree-9 chunks at PRECISION=4.
-
-Device placement: the share pipeline is **pinned to the host CPU backend**.
+Device placement: the single-host share pipeline runs as **plain numpy on
+the host CPU** (exact native int64); the mesh-sharded variant
+(`make_sharded_share_fns`) is jitted shard_map XLA and requires x64 mode.
 TPUs have no native int64 datapath — XLA's x64 rewriter cannot split an
-`s64 dot_general` (observed: `jit(make_shares)` fails AOT compilation on
+`s64 dot_general` (observed: a jitted make_shares fails AOT compilation on
 v5e with "X64 rewriting not implemented" for the share matmul), and the
-values here genuinely need 64 exact integer bits. This is a deliberate
-design decision, not a fallback-by-accident: share algebra is control-plane
-crypto that rides next to the (host-side) EC commitments, its cost is
-O(S·d) integer ops — trivial against the O(d) curve MSM on the same path —
-and pinning it to the always-present CPU backend keeps the TPU program
-free of emulated-int64 stalls. The float ML path never touches this module.
+values here genuinely need 64 exact integer bits (share values reach ~10¹³
+for degree-9 chunks at PRECISION=4). This is a deliberate design decision,
+not a fallback-by-accident: share algebra is control-plane crypto that
+rides next to the (host-side) EC commitments, its cost is O(S·d) integer
+ops — trivial against the O(d) curve MSM on the same path — and keeping it
+in numpy avoids both emulated-int64 stalls on the TPU program AND jit
+dispatch overhead on the host (a CPU-jitted callback paid ~600× the
+matmul's cost in per-call dispatch). The float ML path never touches this
+module.
 """
 
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 PRECISION = 4  # ref: main.go:45
 POLY_SIZE = 10  # ref: main.go:46
 SHARE_OFFSET = 10  # ref: kyber.go:589
-
-
-def _cpu_device():
-    """The host CPU device — present under every JAX backend."""
-    return jax.local_devices(backend="cpu")[0]
 
 
 def _require_x64(what: str) -> None:
@@ -115,9 +112,16 @@ def vandermonde(xs: jax.Array, poly_size: int = POLY_SIZE) -> jax.Array:
     return xs[:, None] ** powers[None, :]
 
 
-# ---- shared kernel bodies: the CPU-pinned jit wrappers and the
-# chunk-sharded shard_map wrappers below must stay mathematically
-# identical, so both call these
+def _vandermonde_np(xs: np.ndarray, poly_size: int) -> np.ndarray:
+    """numpy twin of vandermonde() for the host path — shared by share
+    generation and recovery so the two matrices cannot drift apart."""
+    xsn = np.asarray(xs, dtype=np.int64)
+    return xsn[:, None] ** np.arange(poly_size, dtype=np.int64)[None, :]
+
+
+# ---- shared kernel bodies for the chunk-sharded shard_map wrappers below;
+# the numpy host-path functions implement the identical math (pinned
+# against each other by test_sharded_chunk_axis_matches_unsharded)
 
 
 def _shares_kernel(coeffs: jax.Array, v: jax.Array) -> jax.Array:
@@ -135,26 +139,25 @@ def _recover_kernel(agg: jax.Array, vv: jax.Array) -> jax.Array:
     return jnp.round(sol.T).astype(jnp.int64)
 
 
-@partial(jax.jit, static_argnames=("poly_size", "total_shares"))
-def _make_shares_jit(q: jax.Array, poly_size: int,
-                     total_shares: int) -> jax.Array:
-    coeffs = to_chunks(q, poly_size)  # [C, k]
-    v = vandermonde(share_xs(total_shares), poly_size)  # [S, k]
-    return _shares_kernel(coeffs, v)  # [S, C]
-
-
 def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
-                total_shares: int = 2 * POLY_SIZE) -> jax.Array:
+                total_shares: int = 2 * POLY_SIZE) -> np.ndarray:
     """[d] quantized update → [S, C] share matrix: share s of chunk c is the
-    exact integer evaluation of chunk-polynomial c at x_s. Runs on the host
-    CPU backend (see module docstring: TPUs have no exact-int64 matmul)."""
-    _require_x64("make_shares")
-    q = jnp.asarray(q)
-    if q.dtype != jnp.int64:
+    exact integer evaluation of chunk-polynomial c at x_s. Runs as plain
+    numpy on the host (see module docstring: TPUs have no exact-int64
+    matmul, and a jitted CPU callback pays ~600× the matmul's cost in
+    per-call dispatch — measured 0.11 s dispatch vs 0.2 ms math at mnist
+    shape; the mesh-sharded variant below keeps the XLA path)."""
+    q = np.asarray(q)
+    if q.dtype != np.int64:
         raise TypeError(f"make_shares wants int64 quantized input, got {q.dtype}")
-    with jax.default_device(_cpu_device()):
-        return _make_shares_jit(jax.device_put(q, _cpu_device()),
-                                poly_size, total_shares)
+    d = q.shape[0]
+    c = num_chunks(d, poly_size)
+    padded = np.zeros(c * poly_size, np.int64)
+    padded[:d] = q
+    coeffs = padded.reshape(c, poly_size)  # [C, k]
+    xs = np.arange(total_shares, dtype=np.int64) - SHARE_OFFSET
+    v = _vandermonde_np(xs, poly_size)  # [S, k]
+    return v @ coeffs.T  # [S, C], exact int64
 
 
 def miner_rows(total_shares: int, miner_idx: int, num_miners: int) -> slice:
@@ -163,48 +166,33 @@ def miner_rows(total_shares: int, miner_idx: int, num_miners: int) -> slice:
     return slice(miner_idx * per, (miner_idx + 1) * per)
 
 
-@jax.jit
-def _aggregate_shares_jit(peer_shares: jax.Array) -> jax.Array:
-    return _agg_kernel(peer_shares)
-
-
-def aggregate_shares(peer_shares: jax.Array) -> jax.Array:
+def aggregate_shares(peer_shares: jax.Array) -> np.ndarray:
     """Homomorphic aggregation: [P, S, C] → [S, C]. Works identically on a
     miner's slice [P, S/M, C] (ref: kyber.go:244-287 aggregateSecret).
-    CPU-pinned with the rest of the int64 share pipeline."""
-    with jax.default_device(_cpu_device()):
-        return _aggregate_shares_jit(
-            jax.device_put(jnp.asarray(peer_shares), _cpu_device()))
-
-
-@partial(jax.jit, static_argnames=("poly_size",))
-def _recover_coeffs_jit(agg_shares: jax.Array, xs: jax.Array,
-                        poly_size: int) -> jax.Array:
-    vv = vandermonde(xs, poly_size).astype(jnp.float64)  # [S, k]
-    return _recover_kernel(agg_shares, vv)  # [C, k]
+    Plain numpy with the rest of the host int64 share pipeline."""
+    return np.sum(np.asarray(peer_shares), axis=0)
 
 
 def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
-                   poly_size: int = POLY_SIZE) -> jax.Array:
+                   poly_size: int = POLY_SIZE) -> np.ndarray:
     """[S, C] aggregated shares (+ their x points) → [C, k] int64 chunk
     coefficients via float64 least-squares, rounded (ref: kyber.go:809-867 —
-    the reference also recovers approximately, via mat64 QR). CPU-pinned
-    with the rest of the int64 share pipeline."""
-    _require_x64("recover_coeffs")
-    cpu = _cpu_device()
-    with jax.default_device(cpu):
-        return _recover_coeffs_jit(jax.device_put(jnp.asarray(agg_shares), cpu),
-                                   jax.device_put(jnp.asarray(xs), cpu),
-                                   poly_size)
+    the reference also recovers approximately, via mat64 QR). Plain numpy
+    with the rest of the host int64 share pipeline."""
+    agg = np.asarray(agg_shares)
+    vv = _vandermonde_np(xs, poly_size).astype(np.float64)  # [S, k]
+    sol, _, _, _ = np.linalg.lstsq(vv, agg.astype(np.float64), rcond=None)
+    return np.round(sol.T).astype(np.int64)  # [C, k]
 
 
 def recover_update(agg_shares: jax.Array, xs: jax.Array, num_params: int,
                    poly_size: int = POLY_SIZE,
-                   precision: int = PRECISION) -> jax.Array:
+                   precision: int = PRECISION) -> np.ndarray:
     """Full miner-side recovery: aggregated shares → float aggregate update
     (ref: honest.go:442-502 recoverAggregateUpdates)."""
     coeffs = recover_coeffs(agg_shares, xs, poly_size)
-    return dequantize(from_chunks(coeffs, num_params), precision)
+    flat = coeffs.reshape(-1)[:num_params]
+    return flat.astype(np.float64) / (10.0 ** precision)
 
 
 # ----------------------------------------------------- chunk-axis sharding

@@ -693,6 +693,116 @@ inline void acc_sub_at(uint64_t *acc, int n, int pos, uint64_t v) {
 
 }  // namespace
 
+// Evaluate every chunk's blinding polynomial at every share point, mod the
+// group order q — the worker-side companion tensor to the int64 share
+// matrix (python fallback: commitments.py vss_blind_rows). blinds: C·k
+// 32-byte little-endian canonical values (< q, caller-guaranteed — they
+// come out of a mod-q reduction); xs: S share points with |x| < 2^31;
+// out: S·C 32-byte little-endian values, row-major [s][c].
+//
+// Horner step acc ← (acc·x + b) mod q with a partial reduction exploiting
+// q = 2^252 + DELTA (DELTA ≈ 2^124.4): acc·|x| < q·2^31, split at bit 252
+// into hi·2^252 + lo, and hi·2^252 ≡ −hi·DELTA (mod q) with hi·DELTA ≤
+// 2^156 ≪ q, so one conditional add of q finishes the reduction.
+int ed25519_vss_blind_rows(const uint8_t *blinds, const int64_t *xs,
+                           size_t S, size_t C, size_t k, uint8_t *out) {
+  static const uint64_t QL[4] = {0x5812631A5CF5D3EDULL,
+                                 0x14DEF9DEA2F79CD6ULL, 0ULL,
+                                 0x1000000000000000ULL};
+  static const uint64_t DELTA[2] = {0x5812631A5CF5D3EDULL,
+                                    0x14DEF9DEA2F79CD6ULL};
+  auto ge_q = [](const uint64_t a[4]) {
+    for (int l = 3; l >= 0; l--) {
+      if (a[l] > QL[l]) return true;
+      if (a[l] < QL[l]) return false;
+    }
+    return true;  // equal
+  };
+  auto sub_q = [](uint64_t a[4]) {
+    unsigned __int128 borrow = 0;
+    for (int l = 0; l < 4; l++) {
+      unsigned __int128 d =
+          (unsigned __int128)a[l] - QL[l] - (uint64_t)borrow;
+      a[l] = (uint64_t)d;
+      borrow = (d >> 64) ? 1 : 0;  // wrapped → borrow
+    }
+  };
+  for (size_t s = 0; s < S; s++) {
+    int64_t x = xs[s];
+    uint64_t xa = x < 0 ? (uint64_t)(-(long long)x) : (uint64_t)x;
+    if (xa >> 31) return -1;  // share points are tiny by construction
+    bool xneg = x < 0;
+    for (size_t c = 0; c < C; c++) {
+      uint64_t acc[4] = {0, 0, 0, 0};
+      for (size_t j = k; j-- > 0;) {
+        // acc ← acc·x mod q  (skip when acc is zero)
+        if (acc[0] | acc[1] | acc[2] | acc[3]) {
+          uint64_t v[5];
+          unsigned __int128 carry = 0;
+          for (int l = 0; l < 4; l++) {
+            unsigned __int128 p = (unsigned __int128)acc[l] * xa + carry;
+            v[l] = (uint64_t)p;
+            carry = p >> 64;
+          }
+          v[4] = (uint64_t)carry;
+          // split at bit 252
+          uint64_t hi = (v[3] >> 60) | (v[4] << 4);
+          uint64_t lo[4] = {v[0], v[1], v[2], v[3] & 0x0FFFFFFFFFFFFFFFULL};
+          // lo − hi·DELTA (+q if it underflows)
+          unsigned __int128 p0 = (unsigned __int128)hi * DELTA[0];
+          unsigned __int128 p1 = (unsigned __int128)hi * DELTA[1];
+          uint64_t sub[4] = {(uint64_t)p0, 0, 0, 0};
+          unsigned __int128 mid = (p0 >> 64) + (uint64_t)p1;
+          sub[1] = (uint64_t)mid;
+          sub[2] = (uint64_t)(mid >> 64) + (uint64_t)(p1 >> 64);
+          unsigned __int128 borrow = 0;
+          for (int l = 0; l < 4; l++) {
+            unsigned __int128 d =
+                (unsigned __int128)lo[l] - sub[l] - (uint64_t)borrow;
+            acc[l] = (uint64_t)d;
+            borrow = (d >> 64) ? 1 : 0;
+          }
+          if (borrow) {  // add q back
+            unsigned __int128 cy = 0;
+            for (int l = 0; l < 4; l++) {
+              unsigned __int128 t2 =
+                  (unsigned __int128)acc[l] + QL[l] + (uint64_t)cy;
+              acc[l] = (uint64_t)t2;
+              cy = t2 >> 64;
+            }
+          }
+          if (xneg && (acc[0] | acc[1] | acc[2] | acc[3])) {
+            // negate mod q: acc ← q − acc
+            unsigned __int128 borrow2 = 0;
+            uint64_t r[4];
+            for (int l = 0; l < 4; l++) {
+              unsigned __int128 d =
+                  (unsigned __int128)QL[l] - acc[l] - (uint64_t)borrow2;
+              r[l] = (uint64_t)d;
+              borrow2 = (d >> 64) ? 1 : 0;
+            }
+            memcpy(acc, r, sizeof r);
+          }
+        }
+        // acc ← acc + b_cj  (b < q), one conditional subtract
+        const uint8_t *bb = blinds + 32 * (c * k + j);
+        uint64_t b[4];
+        memcpy(b, bb, 32);
+        unsigned __int128 cy = 0;
+        for (int l = 0; l < 4; l++) {
+          unsigned __int128 t2 =
+              (unsigned __int128)acc[l] + b[l] + (uint64_t)cy;
+          acc[l] = (uint64_t)t2;
+          cy = t2 >> 64;
+        }
+        if (cy || ge_q(acc)) sub_q(acc);
+      }
+      memcpy(out + 32 * (s * C + c), acc, 32);
+    }
+  }
+  return 0;
+}
+
 // Accumulate the lhs scalars of the VSS check: s_tot = Σ γ_rc·row_rc and
 // t_tot = Σ γ_rc·t_rc over all S·C cells. gammas: packed (lo,hi) u64
 // pairs; rows: int64 row-major [r][c]; blinds: 32-byte little-endian
