@@ -101,6 +101,10 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
             ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.ed25519_decompress_batch.restype = ctypes.c_int
+        lib.ed25519_decompress_batch.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
         if not _selfcheck(lib):
             return None
         return lib
@@ -210,6 +214,28 @@ def vss_rlc_scalars(xs: Sequence[int], gammas_buf: bytes, c_chunks: int,
     if rc != 0:
         raise RuntimeError(f"native vss_rlc_scalars failed: {rc}")
     return out_s.raw, out_sign.raw
+
+
+def decompress_batch(compressed: bytes, n: int) -> Optional[List[ed.Point]]:
+    """RFC 8032 decompression of n packed 32-byte points in one native
+    call; None if any fails (caller falls back / rejects)."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(compressed) != 32 * n:
+        raise ValueError("compressed buffer length mismatch")
+    out = ctypes.create_string_buffer(128 * n)
+    rc = lib.ed25519_decompress_batch(compressed, n, out)
+    if rc != 0:
+        return None
+    raw = out.raw
+    pts: List[ed.Point] = []
+    for i in range(n):
+        o = raw[128 * i: 128 * (i + 1)]
+        x = int.from_bytes(o[:32], "little")
+        y = int.from_bytes(o[32:64], "little")
+        t = int.from_bytes(o[96:128], "little")
+        pts.append((x, y, 1, t))
+    return pts
 
 
 def vss_blind_rows_raw(blinds_buf: bytes, xs: Sequence[int], c_chunks: int,

@@ -57,13 +57,9 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     peer (ref: kyber.go:533-562 at d=7,850 dominated its CPU budget,
     SURVEY.md §7.3). The C++ backend in native/ replaces this when built.
     """
-    try:
-        from biscotti_tpu.crypto import _native
-
-        if _native.available():
-            return _native.msm(scalars, points)
-    except ImportError:
-        pass
+    native = _native_mod()
+    if native is not None:
+        return native.msm(scalars, points)
     return _msm_python(scalars, points)
 
 
@@ -147,13 +143,44 @@ def verify_commitment(commitment: bytes, q: np.ndarray, key: CommitKey) -> bool:
 # ------------------------------------------------------------- Schnorr
 
 
+def _native_mod():
+    try:
+        from biscotti_tpu.crypto import _native
+
+        return _native if _native.available() else None
+    except ImportError:
+        return None
+
+
+def base_mult_fast(k: int) -> ed.Point:
+    """k·B through the native fixed-base comb tables when built (~50× the
+    python double-and-add; the comb for B is shared with the Pedersen
+    commitment path since G = B there)."""
+    native = _native_mod()
+    if native is not None:
+        xy = native.batch_commit_xy([int(k) % _Q], [0])
+        x = int.from_bytes(xy[:32], "little")
+        y = int.from_bytes(xy[32:64], "little")
+        return (x, y, 1, (x * y) % ed.P)
+    return ed.base_mult(k)
+
+
+# (secret seed) → (x, prefix, compressed pk): signer identities are
+# long-lived, so the per-sign base_mult for the public key amortizes away
+_sign_key_cache: dict = {}
+
+
 def schnorr_sign(seed: bytes, message: bytes) -> bytes:
     """Deterministic Schnorr over Ed25519 (ref: kyber.go:873-896 signs with
     bn256; the curve is an implementation detail of the capability)."""
-    x, prefix = ed.secret_expand(seed)
-    pk = ed.point_compress(ed.base_mult(x))
+    cached = _sign_key_cache.get(seed)
+    if cached is None:
+        x, prefix = ed.secret_expand(seed)
+        pk = ed.point_compress(base_mult_fast(x))
+        cached = _sign_key_cache[seed] = (x, prefix, pk)
+    x, prefix, pk = cached
     k = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % _Q
-    r_pt = ed.base_mult(k)
+    r_pt = base_mult_fast(k)
     r = ed.point_compress(r_pt)
     c = int.from_bytes(
         hashlib.sha512(r + pk + message).digest(), "little"
@@ -174,13 +201,23 @@ def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
 
     if not items:
         return True
-    scalars: List[int] = []
-    points: List[ed.Point] = []
-    s_tot = 0
     for pub, msg, sig in items:
         if len(sig) != 64:
             return False
-        r_pt = ed.point_decompress(sig[:32])
+    # every signature's R nonce is unique (uncacheable) — decompress them
+    # all in one native call when the library is built
+    native = _native_mod()
+    r_pts: Optional[List[ed.Point]] = None
+    if native is not None:
+        r_pts = native.decompress_batch(
+            b"".join(sig[:32] for _, _, sig in items), len(items))
+        if r_pts is None:
+            return False
+    scalars: List[int] = []
+    points: List[ed.Point] = []
+    s_tot = 0
+    for i, (pub, msg, sig) in enumerate(items):
+        r_pt = r_pts[i] if r_pts is not None else ed.point_decompress(sig[:32])
         y_pt = _pub_point(pub)
         if r_pt is None or y_pt is None:
             return False
@@ -195,7 +232,7 @@ def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
         points.append(r_pt)
         scalars.append((g * c) % _Q)
         points.append(y_pt)
-    lhs = ed.base_mult(s_tot % _Q)
+    lhs = base_mult_fast(s_tot % _Q)
     rhs = msm(scalars, points)
     return ed.point_equal(lhs, rhs)
 
@@ -207,7 +244,12 @@ _pub_cache: dict = {}
 
 def _pub_point(pub: bytes) -> Optional[ed.Point]:
     if pub not in _pub_cache:
-        _pub_cache[pub] = ed.point_decompress(pub)
+        native = _native_mod()
+        if native is not None and len(pub) == 32:
+            pts = native.decompress_batch(pub, 1)
+            _pub_cache[pub] = pts[0] if pts else None
+        else:
+            _pub_cache[pub] = ed.point_decompress(pub)
     return _pub_cache[pub]
 
 
@@ -355,13 +397,9 @@ def vss_commit_chunks(chunks: np.ndarray, seed: bytes,
 def batch_pedersen_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
     """[aᵢ·G + bᵢ·H] as packed 64B affine pairs, native fast path when
     available."""
-    try:
-        from biscotti_tpu.crypto import _native
-
-        if _native.available():
-            return _native.batch_commit_xy(a, b)
-    except ImportError:
-        pass
+    native = _native_mod()
+    if native is not None:
+        return native.batch_commit_xy(a, b)
     out = bytearray()
     for ai, bi in zip(a, b):
         p = ed.point_add(ed.base_mult(_scalar(int(ai))),
@@ -403,12 +441,7 @@ def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
     full-width modmuls per cell."""
     s, c = len(xs), len(blinds)
     k = len(blinds[0]) if blinds else 0
-    try:
-        from biscotti_tpu.crypto import _native
-
-        native = _native if _native.available() else None
-    except ImportError:
-        native = None
+    native = _native_mod()
     if native is not None and c and k and all(len(r) == k for r in blinds):
         # canonicalize mod q before packing: the C kernel requires < q
         # inputs, while this public API (like its python fallback below)
@@ -462,12 +495,7 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
     if len(entropy) < 16 * total_cells:
         return False
 
-    try:
-        from biscotti_tpu.crypto import _native
-
-        native = _native if _native.available() else None
-    except ImportError:
-        native = None
+    native = _native_mod()
 
     s_tot = 0
     t_tot = 0
@@ -550,8 +578,15 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
                         xj *= xi
             all_scalars.extend((8 * v) % _Q for v in coeff)
 
-    lhs = ed.point_add(ed.base_mult((8 * s_tot) % _Q),
-                       ed.scalar_mult((8 * t_tot) % _Q, H_POINT))
+    if native is not None:
+        # s·G + t·H in one native fixed-base comb evaluation
+        xy = native.batch_commit_xy([(8 * s_tot) % _Q], [(8 * t_tot) % _Q])
+        lx = int.from_bytes(xy[:32], "little")
+        ly = int.from_bytes(xy[32:64], "little")
+        lhs: ed.Point = (lx, ly, 1, (lx * ly) % ed.P)
+    else:
+        lhs = ed.point_add(ed.base_mult((8 * s_tot) % _Q),
+                           ed.scalar_mult((8 * t_tot) % _Q, H_POINT))
     if native is not None:
         sbuf = b"".join(sb for sb, _ in native_bufs)
         signs = b"".join(sgn for _, sgn in native_bufs)

@@ -150,6 +150,9 @@ def make_shares(q: jax.Array, poly_size: int = POLY_SIZE,
     q = np.asarray(q)
     if q.dtype != np.int64:
         raise TypeError(f"make_shares wants int64 quantized input, got {q.dtype}")
+    # NOTE for callers re-entering jax with this result: share values reach
+    # ~10¹³, so without jax_enable_x64 a jnp conversion silently truncates
+    # to int32 garbage — keep the result in numpy, or enable x64 first.
     d = q.shape[0]
     c = num_chunks(d, poly_size)
     padded = np.zeros(c * poly_size, np.int64)
@@ -191,8 +194,8 @@ def recover_update(agg_shares: jax.Array, xs: jax.Array, num_params: int,
     """Full miner-side recovery: aggregated shares → float aggregate update
     (ref: honest.go:442-502 recoverAggregateUpdates)."""
     coeffs = recover_coeffs(agg_shares, xs, poly_size)
-    flat = coeffs.reshape(-1)[:num_params]
-    return flat.astype(np.float64) / (10.0 ** precision)
+    flat = from_chunks(coeffs, num_params)  # numpy in → numpy out
+    return np.asarray(flat).astype(np.float64) / (10.0 ** precision)
 
 
 # ----------------------------------------------------- chunk-axis sharding
