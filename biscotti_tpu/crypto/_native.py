@@ -57,9 +57,7 @@ def _selfcheck(lib: ctypes.CDLL) -> bool:
     out = ctypes.create_string_buffer(64)
     if lib.ed25519_msm(sbuf, pbuf, 4, out) != 0:
         return False
-    x = int.from_bytes(out.raw[:32], "little")
-    y = int.from_bytes(out.raw[32:], "little")
-    return ed.point_equal((x, y, 1, (x * y) % ed.P), expect)
+    return ed.point_equal(point_from_xy64(out.raw), expect)
 
 
 def _try_load(full: str) -> Optional[ctypes.CDLL]:
@@ -74,6 +72,12 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         lib.ed25519_batch_commit.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.ed25519_batch_commit_signed.restype = ctypes.c_int
+        lib.ed25519_batch_commit_signed.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p,
         ]
         lib.ed25519_load_xy_batch.restype = ctypes.c_int
         lib.ed25519_load_xy_batch.argtypes = [
@@ -143,6 +147,14 @@ def _fe_bytes(v: int) -> bytes:
     return (v % ed.P).to_bytes(32, "little")
 
 
+def point_from_xy64(buf: bytes) -> ed.Point:
+    """Unpack one 64-byte little-endian affine (x, y) pair — the native
+    library's output wire shape — into an extended-coordinate point."""
+    x = int.from_bytes(buf[:32], "little")
+    y = int.from_bytes(buf[32:64], "little")
+    return (x, y, 1, (x * y) % ed.P)
+
+
 def _point_bytes(p: ed.Point) -> bytes:
     x, y, z, t = p
     return _fe_bytes(x) + _fe_bytes(y) + _fe_bytes(z) + _fe_bytes(t)
@@ -174,9 +186,7 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     rc = lib.ed25519_msm(bytes(sbuf), bytes(pbuf), n, out)
     if rc != 0:
         raise RuntimeError(f"native msm failed: {rc}")
-    x = int.from_bytes(out.raw[:32], "little")
-    y = int.from_bytes(out.raw[32:], "little")
-    return (x, y, 1, (x * y) % ed.P)
+    return point_from_xy64(out.raw)
 
 
 def load_xy_batch(xy: bytes, n: int) -> Optional[bytes]:
@@ -291,9 +301,7 @@ def msm_signed_raw(scalars_buf: bytes, signs_buf: bytes,
     rc = lib.ed25519_msm_signed(scalars_buf, signs_buf, points_buf, n, out)
     if rc != 0:
         raise RuntimeError(f"native msm failed: {rc}")
-    x = int.from_bytes(out.raw[:32], "little")
-    y = int.from_bytes(out.raw[32:], "little")
-    return (x, y, 1, (x * y) % ed.P)
+    return point_from_xy64(out.raw)
 
 
 def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
@@ -322,16 +330,16 @@ def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
     rc = lib.ed25519_msm_signed(bytes(sbuf), bytes(signs), points_buf, n, out)
     if rc != 0:
         raise RuntimeError(f"native msm failed: {rc}")
-    x = int.from_bytes(out.raw[:32], "little")
-    y = int.from_bytes(out.raw[32:], "little")
-    return (x, y, 1, (x * y) % ed.P)
+    return point_from_xy64(out.raw)
 
 
 def batch_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
     """[aᵢ·G + bᵢ·H] as a packed n×64B affine (x,y) buffer — worker-side
-    VSS coefficient commitments (byte-comb fixed-base path in C++). The
-    affine wire format skips both compression here and the sqrt-heavy
-    decompression at every verifier."""
+    VSS coefficient commitments (fixed-base comb path in C++). The affine
+    wire format skips both compression here and the sqrt-heavy
+    decompression at every verifier. Data scalars travel as
+    signed magnitudes so negative quantized coefficients stay a few bytes
+    wide instead of dense q−|a| values."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
     if len(a) != len(b):
@@ -339,13 +347,23 @@ def batch_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
     n = len(a)
     if n == 0:
         return b""
-    abuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in a)
+    mags = bytearray()
+    signs = bytearray(n)
+    for i, s in enumerate(a):
+        v = int(s)
+        if not -ed.Q < v < ed.Q:
+            v %= ed.Q
+        if v < 0:
+            signs[i] = 1
+            v = -v
+        mags += v.to_bytes(32, "little")
     bbuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in b)
     from biscotti_tpu.crypto.commitments import H_POINT
 
     out = ctypes.create_string_buffer(64 * n)
-    rc = lib.ed25519_batch_commit(abuf, bbuf, _point_bytes(ed.BASE),
-                                  _point_bytes(H_POINT), n, out)
+    rc = lib.ed25519_batch_commit_signed(bytes(mags), bytes(signs), bbuf,
+                                         _point_bytes(ed.BASE),
+                                         _point_bytes(H_POINT), n, out)
     if rc != 0:
         raise RuntimeError(f"native batch_commit failed: {rc}")
     return out.raw

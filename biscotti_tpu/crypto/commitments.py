@@ -158,16 +158,17 @@ def base_mult_fast(k: int) -> ed.Point:
     commitment path since G = B there)."""
     native = _native_mod()
     if native is not None:
-        xy = native.batch_commit_xy([int(k) % _Q], [0])
-        x = int.from_bytes(xy[:32], "little")
-        y = int.from_bytes(xy[32:64], "little")
-        return (x, y, 1, (x * y) % ed.P)
+        return native.point_from_xy64(
+            native.batch_commit_xy([int(k) % _Q], [0]))
     return ed.base_mult(k)
 
 
 # (secret seed) → (x, prefix, compressed pk): signer identities are
-# long-lived, so the per-sign base_mult for the public key amortizes away
+# long-lived, so the per-sign base_mult for the public key amortizes away.
+# Bounded: harnesses mint ephemeral identities, and an unbounded cache
+# would both grow forever and pin every expanded secret in memory.
 _sign_key_cache: dict = {}
+_SIGN_KEY_CACHE_MAX = 512
 
 
 def schnorr_sign(seed: bytes, message: bytes) -> bytes:
@@ -177,6 +178,8 @@ def schnorr_sign(seed: bytes, message: bytes) -> bytes:
     if cached is None:
         x, prefix = ed.secret_expand(seed)
         pk = ed.point_compress(base_mult_fast(x))
+        if len(_sign_key_cache) >= _SIGN_KEY_CACHE_MAX:
+            _sign_key_cache.clear()
         cached = _sign_key_cache[seed] = (x, prefix, pk)
     x, prefix, pk = cached
     k = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % _Q
@@ -580,10 +583,8 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
 
     if native is not None:
         # s·G + t·H in one native fixed-base comb evaluation
-        xy = native.batch_commit_xy([(8 * s_tot) % _Q], [(8 * t_tot) % _Q])
-        lx = int.from_bytes(xy[:32], "little")
-        ly = int.from_bytes(xy[32:64], "little")
-        lhs: ed.Point = (lx, ly, 1, (lx * ly) % ed.P)
+        lhs: ed.Point = native.point_from_xy64(
+            native.batch_commit_xy([(8 * s_tot) % _Q], [(8 * t_tot) % _Q]))
     else:
         lhs = ed.point_add(ed.base_mult((8 * s_tot) % _Q),
                            ed.scalar_mult((8 * t_tot) % _Q, H_POINT))

@@ -18,6 +18,8 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <memory>
+#include <mutex>
 #include <vector>
 
 namespace {
@@ -925,17 +927,56 @@ int ed25519_vss_st_accum(const uint64_t *gammas, const int64_t *rows,
   return 0;
 }
 
-// Batch Pedersen commit: out[i] = a[i]·G + b[i]·H for i < n, affine (x,y)
-// 64 bytes each. The worker-side hot spot of verifiable secret sharing —
-// 2·d fixed-base scalar mults per update per round (one commitment per
-// polynomial coefficient; capability parity with the reference's per-chunk
-// commitments, ref: DistSys/kyber.go:579-646) — done with byte-comb tables
-// (v·2^(8j)·P precomputed for every byte position j and value v), so each
-// commitment costs ~36 additions and zero doublings, plus one Montgomery
-// batch inversion for the whole output array.
-int ed25519_batch_commit(const uint8_t *a_scalars, const uint8_t *b_scalars,
-                         const uint8_t *g_point, const uint8_t *h_point,
-                         size_t n, uint8_t *out) {
+namespace {
+
+// Fixed-base comb tables for the Pedersen pair (G, H), built once per
+// process and shared across threads (the runtime calls commits from a
+// to_thread pool — thread_local tables were rebuilt per worker thread):
+//   G: byte comb, 32 positions × 256 values (~1 MB as niels) — the data
+//      scalars are small quantized magnitudes, so few bytes are nonzero
+//   H: 16-bit comb, 16 positions × 65536 values (~126 MB as niels) — the
+//      blind scalars are uniform mod q (dense), so halving the window
+//      count halves the madd count on the dominant term
+struct CombTable {
+  std::vector<nge> entries;  // [positions][1 << bits]
+  uint8_t key[128];
+};
+
+std::mutex comb_tables_mu;
+std::shared_ptr<CombTable> table_g;    // byte comb, [32][256]
+std::shared_ptr<CombTable> table_h16;  // 16-bit comb, [16][65536]
+
+// Lazily build (and cache process-wide) one comb table for base point P.
+// The two tables are independent: a process that only signs/verifies
+// Schnorr touches just the ~1 MB G comb and never pays the ~126 MB H16
+// build (~0.5 s) that only the Pedersen commit path needs.
+std::shared_ptr<CombTable> get_comb(std::shared_ptr<CombTable> &slot,
+                                    const uint8_t *point_key, const ge &P,
+                                    int positions, int bits) {
+  std::lock_guard<std::mutex> lk(comb_tables_mu);
+  if (slot && memcmp(slot->key, point_key, 128) == 0) return slot;
+  auto t = std::make_shared<CombTable>();
+  const size_t vals = size_t(1) << bits;
+  std::vector<ge> flat(positions * vals, ge_identity());
+  ge base = P;
+  for (int j = 0; j < positions; j++) {
+    ge *row = flat.data() + (size_t)j * vals;
+    row[1] = base;
+    for (size_t v = 2; v < vals; v++) row[v] = ge_add(row[v - 1], base);
+    if (j < positions - 1)
+      base = ge_add(row[vals - 1], row[1]);  // 2^(bits·(j+1))·P
+  }
+  ge_batch_to_niels(flat, t->entries);
+  memcpy(t->key, point_key, 128);
+  slot = t;
+  return t;
+}
+
+// shared core: a is signed-magnitude (signs may be null = all positive),
+// b is unsigned full-width
+int batch_commit_core(const uint8_t *a_scalars, const uint8_t *a_signs,
+                      const uint8_t *b_scalars, const uint8_t *g_point,
+                      const uint8_t *h_point, size_t n, uint8_t *out) {
   if (n == 0) return 0;
   auto load_pt = [](const uint8_t *p) {
     ge r;
@@ -947,48 +988,43 @@ int ed25519_batch_commit(const uint8_t *a_scalars, const uint8_t *b_scalars,
   };
   const ge G = load_pt(g_point);
   const ge H = load_pt(h_point);
-
-  // comb[j][v] = v · 2^(8j) · P, j = byte position, v = byte value (1..255),
-  // batch-normalized to niels form once so every table hit is a 7-mul
-  // mixed add (entry 0 is identity-as-niels, never indexed)
-  auto build_comb = [](const ge &P_) {
-    std::vector<ge> flat(32 * 256, ge_identity());
-    ge base = P_;
-    for (int j = 0; j < 32; j++) {
-      ge *row = flat.data() + j * 256;
-      row[1] = base;
-      for (int v = 2; v < 256; v++) row[v] = ge_add(row[v - 1], base);
-      if (j < 31) base = ge_add(row[255], row[1]);  // 256·2^(8j)·P
-    }
-    std::vector<nge> comb;
-    ge_batch_to_niels(flat, comb);
-    return comb;
-  };
-  static thread_local std::vector<nge> comb_g, comb_h;
-  static thread_local uint8_t cached_g[128], cached_h[128];
-  if (comb_g.empty() || memcmp(cached_g, g_point, 128) != 0) {
-    comb_g = build_comb(G);
-    memcpy(cached_g, g_point, 128);
-  }
-  if (comb_h.empty() || memcmp(cached_h, h_point, 128) != 0) {
-    comb_h = build_comb(H);
-    memcpy(cached_h, h_point, 128);
-  }
+  bool any_b = false;
+  for (size_t i = 0; i < 32 * n && !any_b; i++) any_b = b_scalars[i] != 0;
+  auto tg = get_comb(table_g, g_point, G, 32, 8);
+  auto th = any_b ? get_comb(table_h16, h_point, H, 16, 16) : nullptr;
+  const nge *comb_g = tg->entries.data();
+  const nge *comb_h16 = th ? th->entries.data() : nullptr;
 
   std::vector<ge> res(n);
   for (size_t i = 0; i < n; i++) {
-    ge acc = ge_identity();
-    for (int j = 0; j < 32; j++) {
-      uint8_t av = a_scalars[i * 32 + j];
-      uint8_t bv = b_scalars[i * 32 + j];
-      if (j < 31) {  // next byte's table lines, known one step ahead
-        uint8_t an = a_scalars[i * 32 + j + 1];
-        uint8_t bn = b_scalars[i * 32 + j + 1];
-        if (an) __builtin_prefetch(&comb_g[(j + 1) * 256 + an]);
-        if (bn) __builtin_prefetch(&comb_h[(j + 1) * 256 + bn]);
+    // prefetch the NEXT commitment's table entries a whole commitment
+    // (~5 µs of madds) ahead — every H16 read is a fresh line in a 126 MB
+    // table, so one-window-ahead prefetching hid too little latency
+    if (i + 1 < n) {
+      const uint8_t *bn = b_scalars + (i + 1) * 32;
+      for (int j = 0; j < 16; j++) {
+        uint32_t vn = (uint32_t)bn[2 * j] | ((uint32_t)bn[2 * j + 1] << 8);
+        if (vn) {
+          const nge *np_ = &comb_h16[(size_t)j * 65536 + vn];
+          __builtin_prefetch(np_);
+          __builtin_prefetch(reinterpret_cast<const char *>(np_) + 64);
+        }
       }
-      if (av) acc = ge_madd(acc, comb_g[j * 256 + av]);
-      if (bv) acc = ge_madd(acc, comb_h[j * 256 + bv]);
+    }
+    ge acc = ge_identity();
+    const uint8_t *b = b_scalars + i * 32;
+    for (int j = 0; j < 16; j++) {
+      uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
+      if (v) acc = ge_madd(acc, comb_h16[(size_t)j * 65536 + v]);
+    }
+    const uint8_t *a = a_scalars + i * 32;
+    bool neg = a_signs && a_signs[i];
+    for (int j = 0; j < 32; j++) {
+      uint8_t av = a[j];
+      if (av) {
+        const nge &e = comb_g[j * 256 + av];
+        acc = neg ? ge_msub(acc, e) : ge_madd(acc, e);
+      }
     }
     res[i] = acc;
   }
@@ -1003,5 +1039,34 @@ int ed25519_batch_commit(const uint8_t *a_scalars, const uint8_t *b_scalars,
     fe_tobytes(out + i * 64 + 32, y);
   }
   return 0;
+}
+
+}  // namespace
+
+// Batch Pedersen commit: out[i] = a[i]·G + b[i]·H for i < n, affine (x,y)
+// 64 bytes each. The worker-side hot spot of verifiable secret sharing —
+// 2·d fixed-base scalar mults per update per round (one commitment per
+// polynomial coefficient; capability parity with the reference's per-chunk
+// commitments, ref: DistSys/kyber.go:579-646). ~20 niels additions per
+// commitment (16-bit comb on the dense blind + byte comb on the small
+// data magnitude), zero doublings, one Montgomery batch inversion total.
+int ed25519_batch_commit(const uint8_t *a_scalars, const uint8_t *b_scalars,
+                         const uint8_t *g_point, const uint8_t *h_point,
+                         size_t n, uint8_t *out) {
+  return batch_commit_core(a_scalars, nullptr, b_scalars, g_point, h_point,
+                           n, out);
+}
+
+// Signed-magnitude variant: a_signs[i] nonzero means the data scalar is
+// −a_mags[i]. Negative quantized coefficients stay ~3-byte magnitudes
+// instead of becoming dense 252-bit q−|a| values (a 252-bit a costs 32
+// byte-comb additions; |a| costs ~3).
+int ed25519_batch_commit_signed(const uint8_t *a_mags, const uint8_t *a_signs,
+                                const uint8_t *b_scalars,
+                                const uint8_t *g_point,
+                                const uint8_t *h_point, size_t n,
+                                uint8_t *out) {
+  return batch_commit_core(a_mags, a_signs, b_scalars, g_point, h_point, n,
+                           out);
 }
 }

@@ -76,6 +76,54 @@ def test_wide_window_signed_msm_matches_python():
     )
 
 
+def test_decompress_batch_matches_python():
+    """Native RFC-8032 decompression vs the pure-python reference: valid
+    points round-trip, and validity verdicts agree on non-canonical
+    (y ≥ p), non-square, and x=0-with-sign-bit candidates."""
+    rng = random.Random(11)
+    comp = [ed.point_compress(ed.scalar_mult(rng.randrange(1, ed.Q), ed.BASE))
+            for _ in range(32)]
+    pts = _native.decompress_batch(b"".join(comp), len(comp))
+    assert pts is not None
+    for c, p in zip(comp, pts):
+        assert ed.point_equal(p, ed.point_decompress(c))
+    # whole batch rejected when any member is bad
+    assert _native.decompress_batch(
+        b"".join(comp[:3]) + (ed.P + 1).to_bytes(32, "little"), 4) is None
+    # identity: y=1, x=0; the same with the sign bit set must be rejected
+    ident = (1).to_bytes(32, "little")
+    ok = _native.decompress_batch(ident, 1)
+    assert ok is not None and ed.point_equal(ok[0], ed.IDENTITY)
+    signed_zero = (1 | (1 << 255)).to_bytes(32, "little")
+    assert _native.decompress_batch(signed_zero, 1) is None
+    assert ed.point_decompress(signed_zero) is None
+    # verdicts agree on arbitrary candidates (most are non-square)
+    for _ in range(40):
+        cand = rng.randrange(1 << 256).to_bytes(32, "little")
+        a = _native.decompress_batch(cand, 1)
+        b = ed.point_decompress(cand)
+        assert (a is None) == (b is None)
+        if a is not None:
+            assert ed.point_equal(a[0], b)
+
+
+def test_signed_batch_commit_matches_python():
+    """The signed-magnitude Pedersen path (negative quantized coefficients
+    stay short instead of becoming dense q−|a| scalars) against the
+    python point arithmetic, across signs, zero, and full-width values."""
+    rng = random.Random(13)
+    a = ([rng.randrange(-10**9, 10**9) for _ in range(20)]
+         + [0, 1, -1, ed.Q - 1, -(ed.Q - 1)])
+    b = [rng.randrange(ed.Q) for _ in a]
+    b[3] = 0  # a zero blind mixed in
+    raw = cm.batch_pedersen_commit_xy(a, b)
+    for i, (ai, bi) in enumerate(zip(a, b)):
+        expect = ed.point_add(ed.base_mult(ai % ed.Q),
+                              ed.scalar_mult(bi, cm.H_POINT))
+        got = _native.point_from_xy64(raw[64 * i: 64 * (i + 1)])
+        assert ed.point_equal(got, expect), f"commit {i} mismatch"
+
+
 def test_commit_update_uses_native_transparently():
     import numpy as np
 
