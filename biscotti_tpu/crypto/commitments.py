@@ -66,7 +66,19 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
 def _msm_python(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     if len(scalars) != len(points):
         raise ValueError("scalar/point length mismatch")
-    pairs = [(_scalar(s), p) for s, p in zip(scalars, points) if _scalar(s)]
+    # mirror the native wrapper's top-half-negation EXACTLY: s·P and
+    # (q−s)·(−P) differ by q·P, which is NOT the identity for points
+    # carrying a small-order (torsion) component — decompression does no
+    # subgroup check, so an adversarial torsioned point would otherwise
+    # make the two backends disagree on the same inputs (consensus split)
+    pairs = []
+    for s, p in zip(scalars, points):
+        s = _scalar(s)
+        if s > _Q // 2:
+            s = _Q - s
+            p = ed.point_neg(p)
+        pairs.append((s, p))
+    pairs = [(s, p) for s, p in pairs if s]
     if not pairs:
         return ed.IDENTITY
     c = 8 if len(pairs) >= 32 else 4  # window bits
@@ -245,14 +257,20 @@ def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
 _pub_cache: dict = {}
 
 
+def decompress_point(buf: bytes) -> Optional[ed.Point]:
+    """RFC 8032 point decompression, native when built — the shared
+    dispatch for every caller that decodes a single wire point (VRF
+    proofs, public keys). Uncached; long-lived keys go via _pub_point."""
+    native = _native_mod()
+    if native is not None and len(buf) == 32:
+        pts = native.decompress_batch(buf, 1)
+        return pts[0] if pts else None
+    return ed.point_decompress(buf)
+
+
 def _pub_point(pub: bytes) -> Optional[ed.Point]:
     if pub not in _pub_cache:
-        native = _native_mod()
-        if native is not None and len(pub) == 32:
-            pts = native.decompress_batch(pub, 1)
-            _pub_cache[pub] = pts[0] if pts else None
-        else:
-            _pub_cache[pub] = ed.point_decompress(pub)
+        _pub_cache[pub] = decompress_point(pub)
     return _pub_cache[pub]
 
 

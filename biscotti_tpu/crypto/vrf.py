@@ -26,6 +26,19 @@ CHALLENGE_LEN = 16
 PROOF_LEN = 32 + CHALLENGE_LEN + 32
 
 
+# role lotteries run one prove plus committee-many verifies per peer per
+# round; the shared dispatchers in commitments.py route these through the
+# native library when built (the pure-python double-and-add was a measured
+# hot spot of whole-cluster runs) and guarantee the two backends compute
+# identical group elements on ALL inputs, torsioned included
+from biscotti_tpu.crypto.commitments import decompress_point as _decompress
+from biscotti_tpu.crypto.commitments import msm as _msm_dispatch
+
+
+def _msm(scalars, points) -> ed.Point:
+    return _msm_dispatch(list(scalars), list(points))
+
+
 def _encode_to_curve(pk_bytes: bytes, alpha: bytes) -> ed.Point:
     """RFC 9381 §5.4.1.1 TAI preimage layout over the shared hash-to-curve."""
     return ed.hash_to_point(SUITE + b"\x01" + pk_bytes + alpha, b"\x00")
@@ -54,21 +67,22 @@ class VRFKey:
         if len(self.seed) != 32:
             raise ValueError("VRF seed must be 32 bytes")
         self._x, self._prefix = ed.secret_expand(self.seed)
-        self.public = ed.point_compress(ed.base_mult(self._x))
+        self._public_pt = ed.base_mult(self._x)
+        self.public = ed.point_compress(self._public_pt)
 
     def prove(self, alpha: bytes) -> Tuple[bytes, bytes]:
         """(beta, pi): 64-byte pseudorandom output + proof anyone can check
         against `self.public`."""
         h_pt = _encode_to_curve(self.public, alpha)
         h_bytes = ed.point_compress(h_pt)
-        gamma = ed.scalar_mult(self._x, h_pt)
+        gamma = _msm([self._x], [h_pt])
         # deterministic nonce, RFC 8032 style: SHA512(prefix ‖ H)
         k = int.from_bytes(
             hashlib.sha512(self._prefix + h_bytes).digest(), "little"
         ) % ed.Q
-        u = ed.base_mult(k)
-        v = ed.scalar_mult(k, h_pt)
-        y_pt = ed.point_decompress(self.public)
+        u = _msm([k], [ed.BASE])
+        v = _msm([k], [h_pt])
+        y_pt = self._public_pt
         c = _challenge(y_pt, h_pt, gamma, u, v)
         s = (k + c * self._x) % ed.Q
         pi = (
@@ -84,25 +98,23 @@ def verify(public: bytes, alpha: bytes, pi: bytes) -> Optional[bytes]:
     `public`; None on any failure (never raises on malformed input)."""
     if len(pi) != PROOF_LEN:
         return None
-    gamma = ed.point_decompress(pi[:32])
+    gamma = _decompress(pi[:32])
     if gamma is None:
         return None
     c = int.from_bytes(pi[32 : 32 + CHALLENGE_LEN], "little")
     s = int.from_bytes(pi[32 + CHALLENGE_LEN :], "little")
     if s >= ed.Q:
         return None
-    y_pt = ed.point_decompress(public)
+    y_pt = _decompress(public)
     if y_pt is None:
         return None
     try:
         h_pt = _encode_to_curve(public, alpha)
     except ValueError:
         return None
-    # U = s·B − c·Y ; V = s·H − c·Γ
-    u = ed.point_add(ed.base_mult(s), ed.point_neg(ed.scalar_mult(c, y_pt)))
-    v = ed.point_add(
-        ed.scalar_mult(s, h_pt), ed.point_neg(ed.scalar_mult(c, gamma))
-    )
+    # U = s·B − c·Y ; V = s·H − c·Γ (each one two-term MSM)
+    u = _msm([s, ed.Q - (c % ed.Q)], [ed.BASE, y_pt])
+    v = _msm([s, ed.Q - (c % ed.Q)], [h_pt, gamma])
     if _challenge(y_pt, h_pt, gamma, u, v) != c:
         return None
     return _proof_to_hash(gamma)

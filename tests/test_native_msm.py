@@ -124,6 +124,27 @@ def test_signed_batch_commit_matches_python():
         assert ed.point_equal(got, expect), f"commit {i} mismatch"
 
 
+def test_backends_agree_on_torsioned_points():
+    """s·P for s in the top half of Z_q: the native wrapper computes
+    (q−s)·(−P) while the python fallback must mirror it EXACTLY — the two
+    differ by q·P, which is a nonzero small-order element when P carries a
+    torsion component (decompression does no subgroup check). A backend
+    disagreement here is a consensus split on adversarial inputs."""
+    # well-known order-8 point on edwards25519
+    t8 = ed.point_decompress(bytes.fromhex(
+        "c7176a703d4dd84fba3c0b760d10670f2a2053fa2c39ccc64ec7fd7792ac037a"))
+    assert t8 is not None
+    assert ed.is_identity(ed.scalar_mult(8, t8))
+    assert not ed.is_identity(ed.scalar_mult(4, t8))
+    y = ed.scalar_mult(987654321, ed.BASE)
+    y_tors = ed.point_add(y, t8)  # outside the prime-order subgroup
+    rng = random.Random(17)
+    for s in (ed.Q - 3, ed.Q // 2 + 12345, rng.randrange(ed.Q // 2, ed.Q)):
+        a = _native.msm([s, 7], [y_tors, ed.BASE])
+        b = cm._msm_python([s, 7], [y_tors, ed.BASE])
+        assert ed.point_equal(a, b), f"backend split at scalar {s}"
+
+
 def test_commit_update_uses_native_transparently():
     import numpy as np
 
