@@ -38,8 +38,15 @@ _Q = ed.Q
 
 def _hash_to_point(label: bytes) -> ed.Point:
     """Nothing-up-my-sleeve generator derivation via the shared
-    try-and-increment hash-to-curve in ed25519.py."""
-    return ed.hash_to_point(b"biscotti-gen" + label)
+    try-and-increment hash-to-curve in ed25519.py. Injects the native
+    decompression when loadable (identical semantics); falls back cleanly
+    during module import, when decompress_point below is not yet defined
+    (the import-time H_POINT derivation takes the pure path)."""
+    try:
+        dec = decompress_point
+    except NameError:  # import-time H_POINT derivation
+        dec = None
+    return ed.hash_to_point(b"biscotti-gen" + label, decompress=dec)
 
 
 # Secondary generator for Pedersen blinding; independent of B by construction.
@@ -233,7 +240,7 @@ def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
     s_tot = 0
     for i, (pub, msg, sig) in enumerate(items):
         r_pt = r_pts[i] if r_pts is not None else ed.point_decompress(sig[:32])
-        y_pt = _pub_point(pub)
+        y_pt = _pub_point(pub)  # cofactor-cleared 8Y (see _clear8)
         if r_pt is None or y_pt is None:
             return False
         s = int.from_bytes(sig[32:], "little")
@@ -242,9 +249,11 @@ def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
         c = int.from_bytes(
             hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % _Q
         g = int.from_bytes(_os.urandom(16), "little") | 1
-        s_tot += g * s
+        # cofactored form: Σγ·8s·B == Σγ·(8R) + Σγc·(8Y) — every point in
+        # the MSM is torsion-cleared, matching schnorr_verify exactly
+        s_tot += g * 8 * s
         scalars.append(g)
-        points.append(r_pt)
+        points.append(_clear8(r_pt))
         scalars.append((g * c) % _Q)
         points.append(y_pt)
     lhs = base_mult_fast(s_tot % _Q)
@@ -268,9 +277,24 @@ def decompress_point(buf: bytes) -> Optional[ed.Point]:
     return ed.point_decompress(buf)
 
 
+def _clear8(p: ed.Point) -> ed.Point:
+    """8·P via three doublings — kills any small-order (torsion) component,
+    leaving the prime-order part. Schnorr verification here is COFACTORED
+    over cleared points: decompression does no subgroup check, and on a
+    torsioned point the exact values of c·Y vs (q−c)·(−Y) differ by a
+    torsion element, so cofactorless verification would give different
+    verdicts between the single/batch paths (and potentially backends).
+    Clearing the points makes every path compute in the prime-order
+    subgroup, where all of them agree bit-for-bit."""
+    return ed.point_double(ed.point_double(ed.point_double(p)))
+
+
 def _pub_point(pub: bytes) -> Optional[ed.Point]:
+    """Cofactor-CLEARED public point (8·Y) for Schnorr verification —
+    see _clear8. Cached: node identities are long-lived."""
     if pub not in _pub_cache:
-        _pub_cache[pub] = decompress_point(pub)
+        p = decompress_point(pub)
+        _pub_cache[pub] = _clear8(p) if p is not None else None
     return _pub_cache[pub]
 
 
@@ -278,8 +302,8 @@ def schnorr_verify(public: bytes, message: bytes, signature: bytes) -> bool:
     """(ref: kyber.go:898-925)."""
     if len(signature) != 64:
         return False
-    r_pt = ed.point_decompress(signature[:32])
-    y_pt = ed.point_decompress(public)
+    r_pt = decompress_point(signature[:32])
+    y_pt = _pub_point(public)
     if r_pt is None or y_pt is None:
         return False
     s = int.from_bytes(signature[32:], "little")
@@ -288,10 +312,11 @@ def schnorr_verify(public: bytes, message: bytes, signature: bytes) -> bool:
     c = int.from_bytes(
         hashlib.sha512(signature[:32] + public + message).digest(), "little"
     ) % _Q
-    # s·B == R + c·Y
-    lhs = ed.base_mult(s)
-    rhs = ed.point_add(r_pt, ed.scalar_mult(c, y_pt))
-    return ed.point_equal(lhs, rhs)
+    # cofactored: 8s·B − c·(8Y) == 8R over torsion-cleared points (y_pt
+    # from _pub_point is already 8Y) — identical verdicts to the batch
+    # path and across backends on ALL inputs, torsioned included
+    lhs = msm([(8 * s) % _Q, _Q - c if c else 0], [ed.BASE, y_pt])
+    return ed.point_equal(lhs, _clear8(r_pt))
 
 
 # ------------------------------------------------------- Pedersen VSS

