@@ -333,6 +333,27 @@ def msm_raw(scalars: Sequence[int], points_buf: bytes, n: int) -> ed.Point:
     return point_from_xy64(out.raw)
 
 
+def batch_commit_signed_raw(mags_buf: bytes, signs_buf: bytes,
+                            b_buf: bytes, n: int) -> bytes:
+    """Pedersen batch commit over pre-packed buffers: mags n×32B LE
+    magnitudes (< q), signs n bytes, b n×32B LE canonical blinds. The
+    zero-python-marshalling twin of batch_commit_xy."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if (len(mags_buf) != 32 * n or len(signs_buf) != n
+            or len(b_buf) != 32 * n):
+        raise ValueError("buffer length mismatch")
+    from biscotti_tpu.crypto.commitments import H_POINT
+
+    out = ctypes.create_string_buffer(64 * n)
+    rc = lib.ed25519_batch_commit_signed(mags_buf, signs_buf, b_buf,
+                                         _point_bytes(ed.BASE),
+                                         _point_bytes(H_POINT), n, out)
+    if rc != 0:
+        raise RuntimeError(f"native batch_commit failed: {rc}")
+    return out.raw
+
+
 def batch_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
     """[aᵢ·G + bᵢ·H] as a packed n×64B affine (x,y) buffer — worker-side
     VSS coefficient commitments (fixed-base comb path in C++). The affine
@@ -340,8 +361,6 @@ def batch_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
     decompression at every verifier. Data scalars travel as
     signed magnitudes so negative quantized coefficients stay a few bytes
     wide instead of dense q−|a| values."""
-    lib = _load()
-    assert lib is not None, "native library not built (make -C native)"
     if len(a) != len(b):
         raise ValueError("scalar length mismatch")
     n = len(a)
@@ -358,14 +377,6 @@ def batch_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
             v = -v
         mags += v.to_bytes(32, "little")
     bbuf = b"".join((int(s) % ed.Q).to_bytes(32, "little") for s in b)
-    from biscotti_tpu.crypto.commitments import H_POINT
-
-    out = ctypes.create_string_buffer(64 * n)
-    rc = lib.ed25519_batch_commit_signed(bytes(mags), bytes(signs), bbuf,
-                                         _point_bytes(ed.BASE),
-                                         _point_bytes(H_POINT), n, out)
-    if rc != 0:
-        raise RuntimeError(f"native batch_commit failed: {rc}")
-    return out.raw
+    return batch_commit_signed_raw(bytes(mags), bytes(signs), bbuf, n)
 
 

@@ -406,38 +406,62 @@ def eval_poly(coeffs: Sequence[int], x: int) -> int:
 # kyber.go:650-673). On failure, per-worker fallback identifies the cheat.
 
 
+def vss_blind_bytes(n: int, seed: bytes, context: bytes) -> bytes:
+    """n blinding coefficients as packed 32-byte little-endian canonical
+    Z_q values, from ONE SHAKE-256 XOF call: each 32-byte window is masked
+    to 252 bits, giving a value uniform in [0, 2²⁵²) — statistical
+    distance < 2⁻¹²⁸ from uniform mod q (q = 2²⁵² + δ, δ ≈ 2¹²⁴), which
+    the hiding property needs, with zero python bigint traffic."""
+    raw = bytearray(hashlib.shake_256(
+        seed + b"vss-blind-xof" + context).digest(32 * n))
+    arr = np.frombuffer(raw, dtype=np.uint8).reshape(n, 32)
+    arr[:, 31] &= 0x0F  # mask to 252 bits → canonical < q
+    return bytes(raw)
+
+
+def vss_commit_chunks_bytes(chunks: np.ndarray, seed: bytes,
+                            context: bytes) -> Tuple[np.ndarray, bytes]:
+    """Commit every chunk's coefficients — the bytes-native worker path.
+
+    chunks: [C, k] int64 (ss.to_chunks output). Returns (commitments uint8
+    [C, k, 64] affine (x,y) LE pairs, blind coefficients as packed C·k
+    32-byte LE values). The hot spot is 2·C·k fixed-base mults; the native
+    comb path in `native/` takes it when built, fed by numpy-packed
+    buffers (no per-value python ints anywhere on this path)."""
+    c_chunks, k = chunks.shape
+    n = c_chunks * k
+    blind_bytes = vss_blind_bytes(n, seed, context)
+    flat = np.ascontiguousarray(chunks, dtype=np.int64).reshape(n)
+    native = _native_mod()
+    if native is not None:
+        mags = np.zeros((n, 32), dtype=np.uint8)
+        mags[:, :8] = np.abs(flat).astype("<u8").view(np.uint8).reshape(n, 8)
+        signs = (flat < 0).astype(np.uint8)
+        raw = native.batch_commit_signed_raw(
+            mags.tobytes(), signs.tobytes(), blind_bytes, n)
+    else:
+        flat_b = [int.from_bytes(blind_bytes[32 * i: 32 * (i + 1)], "little")
+                  for i in range(n)]
+        raw = batch_pedersen_commit_xy([int(v) for v in flat], flat_b)
+    out = np.frombuffer(raw, dtype=np.uint8)
+    return out.reshape(c_chunks, k, 64).copy(), blind_bytes
+
+
+def _unpack_blinds(blind_bytes: bytes, c_chunks: int,
+                   k: int) -> List[List[int]]:
+    """Packed C·k 32-byte LE blinds → [C][k] python ints."""
+    return [[int.from_bytes(blind_bytes[32 * (ci * k + j):
+                                        32 * (ci * k + j + 1)], "little")
+             for j in range(k)] for ci in range(c_chunks)]
+
+
 def vss_commit_chunks(chunks: np.ndarray, seed: bytes,
                       context: bytes) -> Tuple[np.ndarray, List[List[int]]]:
-    """Commit every chunk's coefficients.
-
-    chunks: [C, k] int64 (ss.to_chunks output). Returns
-    (commitments uint8 [C, k, 64] affine (x,y) LE pairs, blind coefficients
-    [C][k] ints in Z_q). The hot spot is 2·C·k fixed-base mults; the native
-    byte-comb path in `native/` takes it when built."""
+    """Compatibility wrapper over vss_commit_chunks_bytes returning blind
+    coefficients as [C][k] python ints."""
     c_chunks, k = chunks.shape
-    # all blinding coefficients from ONE SHAKE-256 XOF call (the per-value
-    # sha512 loop this replaces was ~25% of worker commit time at d=7850);
-    # 48-byte windows keep the mod-q bias below 2⁻¹³² so each blind is
-    # statistically uniform in Z_q — the hiding property needs that
-    xof = hashlib.shake_256(seed + b"vss-blind-xof" + context)
-    raw_b = xof.digest(48 * c_chunks * k)
-    blinds: List[List[int]] = []
-    flat_a: List[int] = []
-    flat_b: List[int] = []
-    pos = 0
-    for ci in range(c_chunks):
-        row = [
-            int.from_bytes(raw_b[pos + 48 * j: pos + 48 * (j + 1)],
-                           "little") % _Q
-            for j in range(k)
-        ]
-        pos += 48 * k
-        blinds.append(row)
-        flat_a.extend(int(v) for v in chunks[ci])
-        flat_b.extend(row)
-    raw = batch_pedersen_commit_xy(flat_a, flat_b)
-    out = np.frombuffer(raw, dtype=np.uint8)
-    return out.reshape(c_chunks, k, 64).copy(), blinds
+    comms, blind_bytes = vss_commit_chunks_bytes(chunks, seed, context)
+    return comms, _unpack_blinds(blind_bytes, c_chunks, k)
 
 
 def batch_pedersen_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
@@ -474,6 +498,39 @@ def vss_digest(comms: np.ndarray) -> bytes:
     return hashlib.sha256(b"vss" + np.ascontiguousarray(comms).tobytes()).digest()
 
 
+def _blind_rows_python(blinds: List[List[int]],
+                       xs: Sequence[int]) -> np.ndarray:
+    """Pure-python Horner evaluation of the blind-row tensor (the shared
+    fallback body of both vss_blind_rows entry points)."""
+    s, c = len(xs), len(blinds)
+    out = np.zeros((s, c, 32), dtype=np.uint8)
+    for si, x in enumerate(xs):
+        xi = int(x)
+        for ci, coeffs in enumerate(blinds):
+            acc = 0
+            for bj in reversed(coeffs):
+                acc = acc * xi + bj
+            out[si, ci] = np.frombuffer((acc % _Q).to_bytes(32, "little"),
+                                        np.uint8)
+    return out
+
+
+def vss_blind_rows_bytes(blind_bytes: bytes, c_chunks: int, k: int,
+                         xs: Sequence[int]) -> np.ndarray:
+    """vss_blind_rows over the packed 32-byte blind buffer from
+    vss_commit_chunks_bytes — native end-to-end, no python ints."""
+    native = _native_mod()
+    if native is not None and c_chunks and k:
+        raw = native.vss_blind_rows_raw(blind_bytes, [int(x) for x in xs],
+                                        c_chunks, k)
+        if raw is not None:
+            return (np.frombuffer(raw, dtype=np.uint8)
+                    .reshape(len(xs), c_chunks, 32).copy())
+    # straight to python on native failure — re-dispatching through
+    # vss_blind_rows would retry the identical native call
+    return _blind_rows_python(_unpack_blinds(blind_bytes, c_chunks, k), xs)
+
+
 def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
     """Evaluate every chunk's blinding polynomial at every share point:
     uint8 [S, C, 32] (little-endian Z_q values), the companion tensor to the
@@ -498,16 +555,7 @@ def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
         if raw is not None:
             return (np.frombuffer(raw, dtype=np.uint8)
                     .reshape(s, c, 32).copy())
-    out = np.zeros((s, c, 32), dtype=np.uint8)
-    for si, x in enumerate(xs):
-        xi = int(x)
-        for ci, coeffs in enumerate(blinds):
-            acc = 0
-            for bj in reversed(coeffs):
-                acc = acc * xi + bj
-            out[si, ci] = np.frombuffer((acc % _Q).to_bytes(32, "little"),
-                                        np.uint8)
-    return out
+    return _blind_rows_python(blinds, xs)
 
 
 def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],

@@ -1139,9 +1139,10 @@ class PeerAgent:
         padded[: len(q)] = q
         chunks = padded.reshape(c, cfg.poly_size)
         context = self.chain.latest_hash() + int(it).to_bytes(8, "little")
-        comms, blinds = cm.vss_commit_chunks(chunks, self.schnorr_seed, context)
+        comms, blind_bytes = cm.vss_commit_chunks_bytes(
+            chunks, self.schnorr_seed, context)
         xs = [int(x) - ss.SHARE_OFFSET for x in range(cfg.total_shares)]
-        blind_rows = cm.vss_blind_rows(blinds, xs)
+        blind_rows = cm.vss_blind_rows_bytes(blind_bytes, c, cfg.poly_size, xs)
         return comms, blind_rows
 
     def _secret_arrays(self, shares: np.ndarray, blind_rows: np.ndarray,
