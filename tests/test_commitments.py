@@ -217,3 +217,27 @@ def test_torsioned_pubkey_single_and_batch_verdicts_agree():
     hpub = ed.point_compress(ed.base_mult(hx))
     assert cm.schnorr_verify(hpub, b"hello", hs)
     assert cm.batch_schnorr_verify([(hpub, b"hello", hs)])
+
+
+def test_native_library_loads_when_toolchain_present():
+    """The native library is not committed — it auto-builds at first use.
+    On any box with a C++ toolchain it must actually LOAD, or every curve
+    operation silently degrades to the pure-python fallback (an order of
+    magnitude slower) with nothing failing."""
+    import os
+    import shutil
+
+    import pytest
+
+    if os.environ.get("BISCOTTI_NO_NATIVE_BUILD"):
+        pytest.skip("native auto-build deliberately disabled")
+    cxx = os.environ.get("CXX")
+    has_cxx = any(shutil.which(c) for c in
+                  filter(None, (cxx, "g++", "c++", "clang++")))
+    if not has_cxx or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain + make on this box")
+    from biscotti_tpu.crypto import _native
+
+    assert _native.available(), (
+        "native build/load failed despite a toolchain being present — "
+        "check `make -C native` output")
