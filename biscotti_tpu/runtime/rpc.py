@@ -215,16 +215,47 @@ class _Conn:
 
 class Pool:
     """Per-agent connection pool: one persistent connection per (host,
-    port), multiplexing concurrent calls (see module docstring)."""
+    port), multiplexing concurrent calls (see module docstring).
 
-    def __init__(self):
-        self._conns: Dict[Tuple[str, int], _Conn] = {}
+    LRU-capped: a peer's working set is small (its committees, the
+    leader, gossip targets), but the bootstrap announce dials EVERY peer
+    once — without a cap the cluster holds O(N²) sockets and blows the
+    file-descriptor limit around N≈100–120 (observed: 'Too many open
+    files' at N=120 under the default 20k ulimit, ≈2·N² fds). Idle
+    least-recently-used connections are closed beyond `max_conns`;
+    in-flight ones are never evicted, and the next use simply redials."""
+
+    def __init__(self, max_conns: int = 32):
+        from collections import OrderedDict
+
+        self._conns: "OrderedDict[Tuple[str, int], _Conn]" = OrderedDict()
         self._dialing: Dict[Tuple[str, int], asyncio.Task] = {}
+        self._max = max_conns
+
+    def _evict(self) -> None:
+        # drop dead connections regardless of the cap, then close idle
+        # LRU ones until within bounds (busy conns are skipped)
+        for k in [k for k, c in self._conns.items() if not c.alive]:
+            self._conns.pop(k).close()
+        excess = len(self._conns) - self._max
+        if excess <= 0:
+            return
+        for k in list(self._conns.keys()):
+            if excess <= 0:
+                break
+            c = self._conns[k]
+            if c.pending:
+                continue
+            del self._conns[k]
+            c.close()
+            excess -= 1
 
     async def _dial(self, key: Tuple[str, int]) -> _Conn:
         reader, writer = await asyncio.open_connection(*key)
         conn = _Conn(reader, writer)
         self._conns[key] = conn
+        self._conns.move_to_end(key)
+        self._evict()
         return conn
 
     async def _get(self, host: str, port: int, timeout: float) -> _Conn:
@@ -235,6 +266,7 @@ class Pool:
         key = (host, port)
         conn = self._conns.get(key)
         if conn is not None and conn.alive:
+            self._conns.move_to_end(key)
             return conn
         task = self._dialing.get(key)
         if task is None or task.done():
