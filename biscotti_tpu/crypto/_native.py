@@ -109,6 +109,11 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         lib.ed25519_decompress_batch.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ]
+        lib.ed25519_load_xy_sum.restype = ctypes.c_int
+        lib.ed25519_load_xy_sum.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_char_p,
+        ]
         if not _selfcheck(lib):
             return None
         return lib
@@ -286,6 +291,21 @@ def vss_st_accum(gammas_buf: bytes, rows_buf: bytes, blinds_buf: bytes,
         return None
     return (int.from_bytes(out_s.raw, "little", signed=True),
             int.from_bytes(out_t.raw, "little"))
+
+
+def load_xy_sum(xy: bytes, n_batches: int, n: int) -> Optional[bytes]:
+    """Fused validate + pointwise sum: n_batches back-to-back batches of
+    n×64B affine pairs → the summed n×128B extended batch (msm-ready).
+    None if any point is non-canonical or off-curve."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(xy) != 64 * n_batches * n:
+        raise ValueError("xy buffer length mismatch")
+    out = ctypes.create_string_buffer(128 * n)
+    rc = lib.ed25519_load_xy_sum(xy, n_batches, n, out)
+    if rc != 0:
+        return None
+    return out.raw
 
 
 def msm_signed_raw(scalars_buf: bytes, signs_buf: bytes,

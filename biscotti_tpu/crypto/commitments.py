@@ -26,6 +26,7 @@ C++ fast path for the O(d) MSM hot spot, loaded lazily via ctypes.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import List, Optional, Sequence, Tuple
 
@@ -184,10 +185,16 @@ def base_mult_fast(k: int) -> ed.Point:
 
 # (secret seed) → (x, prefix, compressed pk): signer identities are
 # long-lived, so the per-sign base_mult for the public key amortizes away.
-# Bounded: harnesses mint ephemeral identities, and an unbounded cache
-# would both grow forever and pin every expanded secret in memory.
-_sign_key_cache: dict = {}
-_SIGN_KEY_CACHE_MAX = 512
+# An LRU bounded at 128, not unbounded: every retained entry pins an
+# expanded secret scalar in memory (visible to anything that can read
+# process memory or a core dump), so ephemeral harness identities fall
+# out instead of accumulating forever. The bound stays ABOVE the largest
+# in-process cluster the harnesses run (100 peers signing round-robin in
+# one process — eval/scale_test.py — is the LRU worst case; a small
+# bound would thrash it into a 100% miss rate). Re-expanding on a miss
+# costs one sha512 + fixed-base mult (~0.03 ms native).
+_sign_key_cache: "OrderedDict[bytes, tuple]" = OrderedDict()
+_SIGN_KEY_CACHE_MAX = 128
 
 
 def schnorr_sign(seed: bytes, message: bytes) -> bytes:
@@ -197,9 +204,11 @@ def schnorr_sign(seed: bytes, message: bytes) -> bytes:
     if cached is None:
         x, prefix = ed.secret_expand(seed)
         pk = ed.point_compress(base_mult_fast(x))
-        if len(_sign_key_cache) >= _SIGN_KEY_CACHE_MAX:
-            _sign_key_cache.clear()
-        cached = _sign_key_cache[seed] = (x, prefix, pk)
+        while len(_sign_key_cache) >= _SIGN_KEY_CACHE_MAX:
+            _sign_key_cache.popitem(last=False)
+        _sign_key_cache[seed] = cached = (x, prefix, pk)
+    else:
+        _sign_key_cache.move_to_end(seed)
     x, prefix, pk = cached
     k = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % _Q
     r_pt = base_mult_fast(k)
@@ -561,17 +570,40 @@ def vss_blind_rows(blinds: List[List[int]], xs: Sequence[int]) -> np.ndarray:
 def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
                                                np.ndarray, np.ndarray]],
                      entropy: Optional[bytes] = None) -> bool:
-    """Batched share verification over MANY updates at once.
+    """Batched share verification over MANY updates at once, AGGREGATED.
 
     instances: [(comms [C,k,64], xs, share_rows [S,C], blind_rows
-    [S,C,32]), ...]. Accepts iff every (instance w, row r, chunk c) triple
-    satisfies s·G + t·H == Σⱼ x_r^j·C_cj — checked as one random linear
-    combination collapsing to a SINGLE MSM over all instances' points, so
-    a miner verifies its whole round intake in one call. Soundness: γ
-    random 128-bit odd per triple ⇒ a forged share survives with
-    probability 2⁻¹²⁸; all scalars carry the cofactor 8, so small-order
-    point components cannot help a cheater. On False, call per-instance to
-    identify the offender."""
+    [S,C,32]), ...]. Instances that share the same evaluation points and
+    chunk grid — a miner's whole round intake, since every worker shards
+    over the same miner set — are verified as ONE aggregate: Pedersen
+    commitments are additively homomorphic, so the per-cell equations
+        s^w·G + t^w·H == Σⱼ x_r^j·C^w_cj        (one per worker w)
+    sum to
+        (Σ_w s^w)·G + (Σ_w t^w)·H == Σⱼ x_r^j·(Σ_w C^w_cj),
+    and the verify MSM runs over C·k summed points instead of W·C·k —
+    (W−1)·C·k plain point additions replace (W−1)·C·k Pippenger points
+    (~8× wall-clock at cifar dims; the reference instead pays a bn256
+    pairing per share, kyber.go:650-673).
+
+    Soundness (full argument in docs/NATIVE_CRYPTO.md §aggregated-vss):
+    one random odd 128-bit γ per (row, chunk) cell, SHARED by all workers
+    in the group, with the cofactor 8 folded into every scalar. Any share
+    inconsistent with its own commitments makes the aggregate equation
+    fail with probability 1−2⁻¹²⁸ — detection of a lone cheater is NOT
+    weakened — unless a coalition corrupts the SAME cell with errors that
+    cancel in the group sum. That residual acceptance is harmless ONLY
+    for an aggregate covering the whole group (the recovered sum still
+    equals the sum of the committed values); an aggregate over a PARTIAL
+    group would break the cancellation, so the runtime re-runs this check
+    over exactly the aggregation set whenever it does not cover whole
+    verified batches (peer.partial_batch_members /
+    PeerAgent._ensure_subset_consistent). Callers outside the peer
+    runtime must maintain the same invariant: True from this function
+    certifies Σ-consistency of THESE instances as one group, not of
+    arbitrary sub-multisets. Per-worker identification — call with a
+    single instance, which is exact — runs only on failure, costing O(W)
+    single checks in the Byzantine case the cheater is evicted and
+    debited for."""
     import os as _os
 
     total_cells = 0
@@ -591,73 +623,93 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
 
     native = _native_mod()
 
+    # Group by (evaluation points, chunk grid); every group member shares
+    # one γ vector and one RLC scalar set, and contributes its points to a
+    # single summed batch. Entropy windows stay per-instance (16·S·C bytes
+    # each, same contract as the ungrouped design); a group consumes its
+    # FIRST member's window.
+    groups: dict = {}
+    off = 0
+    for inst in instances:
+        comms, xs, _, _ = inst
+        key = (tuple(int(x) for x in xs), comms.shape[0], comms.shape[1])
+        groups.setdefault(key, []).append((inst, off))
+        off += len(xs) * comms.shape[0]
+
     s_tot = 0
     t_tot = 0
     all_scalars: List[int] = []  # python fallback path
     native_bufs: List[Tuple[bytes, bytes]] = []  # (magnitudes, signs)
     all_pts: List[ed.Point] = []
-    all_bufs: List[bytes] = []
-    gi = 0
-    for comms, xs, rows, blind_rows in instances:
-        c_chunks, k, _ = comms.shape
-        comm_bytes = np.ascontiguousarray(comms).tobytes()
-        if native is not None:
-            buf = native.load_xy_batch(comm_bytes, c_chunks * k)
-            if buf is None:
-                return False
-            all_bufs.append(buf)
-        else:
-            for i in range(c_chunks * k):
-                p = _xy_to_point(comm_bytes[64 * i: 64 * i + 64])
-                if p is None:
-                    return False
-                all_pts.append(p)
-        # RLC accumulation over plain (signed) integers with one mod-q
-        # reduction per accumulator at the end: x is small (|x| ≤ S), so
-        # γ·xʲ stays ≲ 2¹⁷² and full-width modmuls are avoided entirely.
-        # The cofactor 8 is folded in at reduction time (everything is
-        # linear in γ). The per-cell k-power chain — ~2M small-int ops per
-        # mnist round — runs in C++ when the native library is loaded.
-        rows = np.asarray(rows)
+    sum_bufs: List[bytes] = []  # native: per-group summed point batches
+    for (xs_key, c_chunks, k), members in groups.items():
+        xs = list(xs_key)
         cells = len(xs) * c_chunks
         # gamma_i = entropy 16-byte window with the low bit forced — as an
         # int for the python s/t accumulation, and verbatim as the packed
         # (lo u64, hi u64) little-endian pair the native RLC consumes
-        gam_bytes = bytearray(entropy[16 * gi: 16 * (gi + cells)])
+        g0 = members[0][1]
+        gam_bytes = bytearray(entropy[16 * g0: 16 * (g0 + cells)])
         for i in range(0, len(gam_bytes), 16):
             gam_bytes[i] |= 1
         gam_bytes = bytes(gam_bytes)
-        gi += cells
-        blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
-        if native is not None:
-            # fused native path: lhs accumulators AND RLC power chains →
-            # MSM-ready signed magnitude buffers (cofactor folded in C++);
-            # zero python bignum traffic on the verify hot path
-            st_acc = native.vss_st_accum(
-                gam_bytes,
-                np.ascontiguousarray(rows, dtype=np.int64).tobytes(),
-                blind_bytes, len(xs), c_chunks)
-            if st_acc is None:
-                return False  # non-canonical blind value
-            s_tot += st_acc[0]
-            t_tot += st_acc[1]
-            sb, sgn = native.vss_rlc_scalars(list(xs), gam_bytes,
-                                             c_chunks, k)
-            native_bufs.append((sb, sgn))
-        else:
-            cell = 0
-            for r, x in enumerate(xs):
-                for ci in range(c_chunks):
-                    g = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
-                                       "little")
-                    cell += 1
-                    s_tot += g * int(rows[r, ci])
-                    off = 32 * (r * c_chunks + ci)
-                    t_val = int.from_bytes(blind_bytes[off: off + 32],
-                                           "little")
-                    if t_val >= _Q:
+
+        loaded: List = []
+        for (comms, _xs, rows, blind_rows), _o in members:
+            comm_bytes = np.ascontiguousarray(comms).tobytes()
+            rows = np.asarray(rows)
+            blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
+            if native is not None:
+                loaded.append(comm_bytes)
+                # fused native path: lhs accumulators run per member with
+                # the SHARED γ (linearity makes Σ_w γ·s^w ≡ γ·Σ_w s^w);
+                # zero python bignum traffic on the verify hot path
+                st_acc = native.vss_st_accum(
+                    gam_bytes,
+                    np.ascontiguousarray(rows, dtype=np.int64).tobytes(),
+                    blind_bytes, len(xs), c_chunks)
+                if st_acc is None:
+                    return False  # non-canonical blind value
+                s_tot += st_acc[0]
+                t_tot += st_acc[1]
+            else:
+                pts: List[ed.Point] = []
+                for i in range(c_chunks * k):
+                    p = _xy_to_point(comm_bytes[64 * i: 64 * i + 64])
+                    if p is None:
                         return False
-                    t_tot += g * t_val
+                    pts.append(p)
+                loaded.append(pts)
+                cell = 0
+                for r, x in enumerate(xs):
+                    for ci in range(c_chunks):
+                        g = int.from_bytes(
+                            gam_bytes[16 * cell: 16 * (cell + 1)], "little")
+                        cell += 1
+                        s_tot += g * int(rows[r, ci])
+                        boff = 32 * (r * c_chunks + ci)
+                        t_val = int.from_bytes(blind_bytes[boff: boff + 32],
+                                               "little")
+                        if t_val >= _Q:
+                            return False
+                        t_tot += g * t_val
+
+        # RLC accumulation over plain (signed) integers with one mod-q
+        # reduction per accumulator at the end: x is small (|x| ≤ S), so
+        # γ·xʲ stays ≲ 2¹⁷² and full-width modmuls are avoided entirely.
+        # The cofactor 8 is folded in at reduction time. ONE scalar set
+        # per group — the per-cell k-power chain runs once, not per worker.
+        if native is not None:
+            sb, sgn = native.vss_rlc_scalars(xs, gam_bytes, c_chunks, k)
+            native_bufs.append((sb, sgn))
+            # ONE fused validate+sum pass over the whole group's affine
+            # commitments — no intermediate 128B extended batches
+            buf = native.load_xy_sum(b"".join(loaded), len(loaded),
+                                     c_chunks * k)
+            if buf is None:
+                return False
+            sum_bufs.append(buf)
+        else:
             coeff = [0] * (c_chunks * k)
             cell = 0
             for r, x in enumerate(xs):
@@ -671,20 +723,23 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
                         coeff[base + j] += xj
                         xj *= xi
             all_scalars.extend((8 * v) % _Q for v in coeff)
+            summed = loaded[0]
+            for pts in loaded[1:]:
+                summed = [ed.point_add(a, b)
+                          for a, b in zip(summed, pts)]
+            all_pts.extend(summed)
 
     if native is not None:
         # s·G + t·H in one native fixed-base comb evaluation
         lhs: ed.Point = native.point_from_xy64(
             native.batch_commit_xy([(8 * s_tot) % _Q], [(8 * t_tot) % _Q]))
+        sbuf = b"".join(sb for sb, _ in native_bufs)
+        signs = b"".join(sgn for _, sgn in native_bufs)
+        rhs = native.msm_signed_raw(sbuf, signs, b"".join(sum_bufs),
+                                    len(signs))
     else:
         lhs = ed.point_add(ed.base_mult((8 * s_tot) % _Q),
                            ed.scalar_mult((8 * t_tot) % _Q, H_POINT))
-    if native is not None:
-        sbuf = b"".join(sb for sb, _ in native_bufs)
-        signs = b"".join(sgn for _, sgn in native_bufs)
-        rhs = native.msm_signed_raw(sbuf, signs, b"".join(all_bufs),
-                                    len(signs))
-    else:
         rhs = msm(all_scalars, all_pts)
     return ed.point_equal(lhs, rhs)
 

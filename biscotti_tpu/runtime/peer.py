@@ -77,6 +77,19 @@ def _keyless_pubs(seed: int, node: int) -> Tuple[bytes, bytes]:
     return _keyless_pub_cache[key]
 
 
+def partial_batch_members(batch_of: Dict[int, frozenset],
+                          nodes: Sequence[int]) -> List[int]:
+    """Sids in `nodes` whose verification batch is NOT fully contained in
+    `nodes`. The aggregated VSS check (cm.vss_verify_multi) proves
+    consistency of each intake batch AS A WHOLE; error cancellation inside
+    a batch is harmless only when the whole batch is aggregated, so an
+    aggregate over a partial batch must re-prove exactly these members at
+    the aggregation boundary (docs/NATIVE_CRYPTO.md §aggregated-vss)."""
+    nset = set(nodes)
+    return [n for n in nodes
+            if batch_of.get(n) is None or not batch_of[n] <= nset]
+
+
 @dataclass
 class RoundState:
     """Everything scoped to one iteration; rebuilt on every round
@@ -97,6 +110,14 @@ class RoundState:
     # identify offenders when the batch fails
     miner_vss: Dict[int, Tuple[np.ndarray, np.ndarray]] = field(
         default_factory=dict)
+    # (comms, blinds) retained for sids that passed verification, plus the
+    # batch each sid was verified IN: the aggregated check is sound for an
+    # aggregate covering a WHOLE batch, so serving any partial batch
+    # re-checks exactly the partial members against these records (see
+    # docs/NATIVE_CRYPTO.md §aggregated-vss and _ensure_subset_consistent)
+    miner_vss_records: Dict[int, Tuple[np.ndarray, np.ndarray]] = field(
+        default_factory=dict)
+    miner_vss_batch: Dict[int, frozenset] = field(default_factory=dict)
     vss_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     # worker-provided verifier signatures, carried into the minted block's
     # update records so block quorums are re-verifiable by every receiver
@@ -795,10 +816,22 @@ class PeerAgent:
                 with self.phases.phase("miner_verify"):
                     ok = await asyncio.to_thread(
                         cm.vss_verify_multi, list(pending.values()))
-                if not ok:
+                if ok:
+                    # the whole batch is consistent AS A GROUP: remember who
+                    # was verified together, so partial-batch aggregates are
+                    # re-checked at the aggregation boundary
+                    batch = frozenset(pending)
+                    for sid, inst in pending.items():
+                        st.miner_vss_records[sid] = (inst[0], inst[3])
+                        st.miner_vss_batch[sid] = batch
+                else:
                     for sid, inst in pending.items():
                         if await asyncio.to_thread(cm.vss_verify_multi,
                                                    [inst]):
+                            # single-instance checks are exact — the sid is
+                            # individually consistent, a singleton batch
+                            st.miner_vss_records[sid] = (inst[0], inst[3])
+                            st.miner_vss_batch[sid] = frozenset((sid,))
                             continue
                         st.miner_shares.pop(sid, None)
                         commitment = st.miner_commitments.pop(sid, b"")
@@ -806,6 +839,52 @@ class PeerAgent:
                                             "share rows fail VSS verification")
                 for sid in pending:
                     st.miner_vss.pop(sid, None)
+
+    async def _ensure_subset_consistent(self, st: RoundState,
+                                        nodes: List[int]) -> bool:
+        """Aggregation-boundary VSS re-check: True iff the aggregate over
+        `nodes` provably equals the sum of their committed values. Whole
+        verified batches pass for free; members of partially-included
+        batches are re-proved as a group of their own (a coalition whose
+        errors cancelled inside the intake batch cannot cancel here,
+        because the check now runs over EXACTLY the aggregation set).
+        Offenders surfaced by a failed re-check are rejected and debited
+        like any intake failure."""
+        if st.my_xs is None or not self.cfg.secure_agg:
+            return True
+        pending = partial_batch_members(st.miner_vss_batch, nodes)
+        if not pending:
+            return True
+        xs = st.my_xs
+        insts: Dict[int, tuple] = {}
+        for sid in pending:
+            rec = st.miner_vss_records.get(sid)
+            rows = st.miner_shares.get(sid)
+            if rec is None or rows is None:
+                # cannot re-prove without the retained records: drop the
+                # sid from the servable set (no debit — this is a state
+                # gap, not verification evidence) so callers that shrink
+                # the set and retry always make progress
+                st.miner_shares.pop(sid, None)
+                st.miner_vss_batch.pop(sid, None)
+                return False
+            insts[sid] = (rec[0], xs, rows, rec[1])
+        with self.phases.phase("miner_verify"):
+            ok = await asyncio.to_thread(cm.vss_verify_multi,
+                                         list(insts.values()))
+        if ok:
+            return True
+        for sid, inst in insts.items():
+            if await asyncio.to_thread(cm.vss_verify_multi, [inst]):
+                continue
+            st.miner_shares.pop(sid, None)
+            st.miner_vss_records.pop(sid, None)
+            st.miner_vss_batch.pop(sid, None)
+            commitment = st.miner_commitments.pop(sid, b"")
+            self._reject_source(st, sid, st.iteration, commitment,
+                                "share rows fail aggregation-boundary "
+                                "VSS re-check")
+        return False
 
     async def _h_request_noise(self, meta, arrays):
         """Noiser serving its presampled DP noise for the round
@@ -992,6 +1071,8 @@ class PeerAgent:
         # < 2× forces any two recovering miner subsets to overlap in a
         # miner whose once-only guard then fires; or an explicit signed
         # set-agreement round among miners.
+        if not await self._ensure_subset_consistent(st, nodes):
+            raise RPCError("aggregation set fails VSS re-check")
         st.served_part = sorted(nodes)
         stack = np.stack([st.miner_shares[n] for n in nodes])
         agg = np.asarray(ss.aggregate_shares(stack))
@@ -1220,10 +1301,10 @@ class PeerAgent:
         if cfg.secure_agg and not cfg.fedsys:
             # settle our own intake's VSS verification before agreeing on
             # the contributor set (other miners settle theirs when we call
-            # GetUpdateList/GetMinerPart on them)
+            # GetUpdateList/GetMinerPart on them); rejected_ids is
+            # snapshotted AFTER the aggregation-boundary re-check below so
+            # offenders it surfaces are debited too
             await self._verify_intake(st)
-        rejected_ids: Set[int] = set(st.miner_rejected)
-        if cfg.secure_agg and not cfg.fedsys:
             _, miners, _, _ = self.role_map.committee()
             miners = sorted(miners)
             # 1. agree on the contributor set: intersection across miners
@@ -1241,6 +1322,19 @@ class PeerAgent:
                 except Exception:
                     node_sets.append(set())
             nodes = sorted(set.intersection(*node_sets)) if node_sets else []
+            # aggregation-boundary re-check (docs §aggregated-vss): when
+            # the agreed set covers the leader's intake batch only
+            # partially, the partial members are re-proved; offenders the
+            # re-check surfaces are rejected with LEADER evidence (so the
+            # minted block debits them), dropped from the set, and the
+            # remainder re-proved — colluders whose corruptions cancelled
+            # inside the intake batch are caught the moment the agreed
+            # set splits the coalition. Terminates: every False iteration
+            # removes at least one sid from miner_shares.
+            while nodes and not await self._ensure_subset_consistent(
+                    st, nodes):
+                nodes = [n for n in nodes if n in st.miner_shares]
+            rejected_ids = set(st.miner_rejected)
             agg = np.zeros(self.trainer.num_params, np.float64)
             if nodes:
                 # 2. gather every miner's aggregated slice
@@ -1280,6 +1374,7 @@ class PeerAgent:
                       for n in nodes]
             contributors = list(nodes)
         else:
+            rejected_ids = set(st.miner_rejected)
             updates = [st.miner_updates[k] for k in sorted(st.miner_updates)]
             agg = np.zeros(self.trainer.num_params, np.float64)
             if updates:

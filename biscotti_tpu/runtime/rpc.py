@@ -232,9 +232,13 @@ class Pool:
         self._dialing: Dict[Tuple[str, int], asyncio.Task] = {}
         self._max = max_conns
 
-    def _evict(self) -> None:
+    def _evict(self, exempt: Optional[Tuple[str, int]] = None) -> None:
         # drop dead connections regardless of the cap, then close idle
-        # LRU ones until within bounds (busy conns are skipped)
+        # LRU ones until within bounds (busy conns are skipped, as is the
+        # freshly-dialed `exempt` conn: it looks idle only because its
+        # first RPC has not registered in pending yet — with >max_conns
+        # dials in flight, the N=100 announce fan-out, evicting it would
+        # hand its caller a closed conn)
         for k in [k for k, c in self._conns.items() if not c.alive]:
             self._conns.pop(k).close()
         excess = len(self._conns) - self._max
@@ -243,6 +247,8 @@ class Pool:
         for k in list(self._conns.keys()):
             if excess <= 0:
                 break
+            if k == exempt:
+                continue
             c = self._conns[k]
             if c.pending:
                 continue
@@ -255,7 +261,7 @@ class Pool:
         conn = _Conn(reader, writer)
         self._conns[key] = conn
         self._conns.move_to_end(key)
-        self._evict()
+        self._evict(exempt=key)
         return conn
 
     async def _get(self, host: str, port: int, timeout: float) -> _Conn:

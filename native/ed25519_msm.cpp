@@ -17,6 +17,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -559,14 +560,15 @@ int ed25519_scalarmult(const uint8_t *scalar, const uint8_t *point,
   return ed25519_msm(scalar, point, 1, out);
 }
 
-// Batch affine-coordinate loader: n×64-byte (x,y) little-endian pairs →
-// n×128-byte extended (X,Y,Z,T) buffers. Each point is checked canonical
-// (x, y < p) and ON-CURVE (-x² + y² == 1 + d·x²·y²) — ~7 field mults per
-// point versus the ~255 squarings a compressed-point sqrt costs, which is
-// why the VSS wire format ships affine pairs. Subgroup membership is NOT
-// checked (callers fold the cofactor 8 into their verification scalars).
-// Returns 0 when every point loads, else 1+index of the first bad one.
-int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
+// The ONE affine-pair validator both loaders share (security-critical —
+// keep a single copy): canonical coords (x, y < p) and ON-CURVE
+// (-x² + y² == 1 + d·(x·y)²) — ~7 field mults per point versus the ~255
+// squarings a compressed-point sqrt costs, which is why the VSS wire
+// format ships affine pairs. Subgroup membership is NOT checked (callers
+// fold the cofactor 8 into their verification scalars). On success fills
+// x, y and the t = x·y product (already needed by the curve equation,
+// reused by callers for extended/niels forms).
+static bool load_affine_checked(const uint8_t *xb, fe &x, fe &y, fe &t) {
   static const uint8_t pbytes[32] = {
       0xED, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
       0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
@@ -578,24 +580,68 @@ int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
     }
     return false;  // == p
   };
+  const uint8_t *yb = xb + 32;
+  if (!canonical(xb) || !canonical(yb)) return false;
+  x = fe_frombytes(xb);
+  y = fe_frombytes(yb);
+  t = fe_mul(x, y);
+  // -x^2 + y^2 == 1 + d*(x*y)^2
+  fe lhs = fe_sub(fe_sq(y), fe_sq(x));
+  fe rhs = fe_add(fe_one(), fe_mul(consts().d, fe_sq(t)));
+  return fe_eq(lhs, rhs);
+}
+
+// Batch affine-coordinate loader: n×64-byte (x,y) little-endian pairs →
+// n×128-byte extended (X,Y,Z,T) buffers, validated by
+// load_affine_checked. Returns 0 when every point loads, else 1+index of
+// the first bad one.
+int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
   for (size_t i = 0; i < n; i++) {
-    const uint8_t *xb = xy + i * 64;
-    const uint8_t *yb = xb + 32;
-    if (!canonical(xb) || !canonical(yb)) return (int)(i + 1);
-    fe x = fe_frombytes(xb);
-    fe y = fe_frombytes(yb);
-    fe x2 = fe_sq(x);
-    fe y2 = fe_sq(y);
-    // -x^2 + y^2 == 1 + d*x^2*y^2
-    fe lhs = fe_sub(y2, x2);
-    fe rhs = fe_add(fe_one(), fe_mul(consts().d, fe_mul(x2, y2)));
-    if (!fe_eq(lhs, rhs)) return (int)(i + 1);
+    fe x, y, t;
+    if (!load_affine_checked(xy + i * 64, x, y, t)) return (int)(i + 1);
     fe_tobytes(out + i * 128, x);
     fe_tobytes(out + i * 128 + 32, y);
     fe one = fe_one();
     fe_tobytes(out + i * 128 + 64, one);
-    fe t = fe_mul(x, y);
     fe_tobytes(out + i * 128 + 96, t);
+  }
+  return 0;
+}
+
+// Fused affine-load + pointwise-sum: B batches of n×64B affine (x,y)
+// pairs → ONE n×128B extended batch, out[i] = Σ_b in[b·n + i]. Each point
+// is validated exactly like ed25519_load_xy_batch (canonical, on-curve;
+// subgroup left to the callers' cofactored scalars), but the intermediate
+// 128B serialize/re-parse round trip of load-then-sum is gone and the
+// accumulation runs as 7-mul mixed additions against the affine input
+// (whose x·y product the on-curve check already computed). Returns 0, or
+// 1 + flat index (b·n + i) of the first invalid point so the caller can
+// attribute the bad batch.
+int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
+                        uint8_t *out) {
+  if (n_batches == 0 || n == 0) return 1;
+  std::vector<ge> acc(n);
+  // batch-major sweep: each pass reads one batch sequentially (cache-
+  // friendly at C·k ≈ 62k points × 64B) and folds it into the running sum
+  for (size_t b = 0; b < n_batches; b++) {
+    for (size_t i = 0; i < n; i++) {
+      fe x, y, t;
+      if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t))
+        return (int)(b * n + i + 1);
+      if (b == 0) {
+        acc[i] = ge{x, y, fe_one(), t};
+      } else {
+        nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
+        acc[i] = ge_madd(acc[i], q);
+      }
+    }
+  }
+  for (size_t i = 0; i < n; i++) {
+    uint8_t *o = out + i * 128;
+    fe_tobytes(o, acc[i].X);
+    fe_tobytes(o + 32, acc[i].Y);
+    fe_tobytes(o + 64, acc[i].Z);
+    fe_tobytes(o + 96, acc[i].T);
   }
   return 0;
 }
@@ -945,6 +991,22 @@ struct CombTable {
 std::mutex comb_tables_mu;
 std::shared_ptr<CombTable> table_g;    // byte comb, [32][256]
 std::shared_ptr<CombTable> table_h16;  // 16-bit comb, [16][65536]
+std::shared_ptr<CombTable> table_h8;   // byte comb for H (memory opt-down)
+
+// BISCOTTI_H_COMB=byte drops the H table from the 16-bit comb (~126 MB
+// resident per process, ~170 MB transient during the build) to the ~1 MB
+// byte comb at ~2× the madds on the commit path. For one peer per host
+// the 16-bit comb is the right trade; a 100-process single-box cluster
+// would otherwise pay >12 GB aggregate, since the table is built lazily
+// AFTER fork and cannot be shared.
+bool use_h_byte_comb() {
+  static int v = -1;
+  if (v < 0) {
+    const char *e = getenv("BISCOTTI_H_COMB");
+    v = (e && (strcmp(e, "byte") == 0 || strcmp(e, "8") == 0)) ? 1 : 0;
+  }
+  return v == 1;
+}
 
 // Lazily build (and cache process-wide) one comb table for base point P.
 // The two tables are independent: a process that only signs/verifies
@@ -990,22 +1052,26 @@ int batch_commit_core(const uint8_t *a_scalars, const uint8_t *a_signs,
   const ge H = load_pt(h_point);
   bool any_b = false;
   for (size_t i = 0; i < 32 * n && !any_b; i++) any_b = b_scalars[i] != 0;
+  const bool h_byte = use_h_byte_comb();
   auto tg = get_comb(table_g, g_point, G, 32, 8);
-  auto th = any_b ? get_comb(table_h16, h_point, H, 16, 16) : nullptr;
+  auto th = !any_b ? nullptr
+            : h_byte ? get_comb(table_h8, h_point, H, 32, 8)
+                     : get_comb(table_h16, h_point, H, 16, 16);
   const nge *comb_g = tg->entries.data();
-  const nge *comb_h16 = th ? th->entries.data() : nullptr;
+  const nge *comb_h = th ? th->entries.data() : nullptr;
 
   std::vector<ge> res(n);
   for (size_t i = 0; i < n; i++) {
     // prefetch the NEXT commitment's table entries a whole commitment
     // (~5 µs of madds) ahead — every H16 read is a fresh line in a 126 MB
-    // table, so one-window-ahead prefetching hid too little latency
-    if (i + 1 < n) {
+    // table, so one-window-ahead prefetching hid too little latency.
+    // (The ~1 MB byte comb lives in cache; prefetching buys nothing.)
+    if (!h_byte && comb_h && i + 1 < n) {
       const uint8_t *bn = b_scalars + (i + 1) * 32;
       for (int j = 0; j < 16; j++) {
         uint32_t vn = (uint32_t)bn[2 * j] | ((uint32_t)bn[2 * j + 1] << 8);
         if (vn) {
-          const nge *np_ = &comb_h16[(size_t)j * 65536 + vn];
+          const nge *np_ = &comb_h[(size_t)j * 65536 + vn];
           __builtin_prefetch(np_);
           __builtin_prefetch(reinterpret_cast<const char *>(np_) + 64);
         }
@@ -1013,9 +1079,16 @@ int batch_commit_core(const uint8_t *a_scalars, const uint8_t *a_signs,
     }
     ge acc = ge_identity();
     const uint8_t *b = b_scalars + i * 32;
-    for (int j = 0; j < 16; j++) {
-      uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
-      if (v) acc = ge_madd(acc, comb_h16[(size_t)j * 65536 + v]);
+    if (h_byte && comb_h) {
+      for (int j = 0; j < 32; j++) {
+        uint8_t v = b[j];
+        if (v) acc = ge_madd(acc, comb_h[(size_t)j * 256 + v]);
+      }
+    } else if (comb_h) {
+      for (int j = 0; j < 16; j++) {
+        uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
+        if (v) acc = ge_madd(acc, comb_h[(size_t)j * 65536 + v]);
+      }
     }
     const uint8_t *a = a_scalars + i * 32;
     bool neg = a_signs && a_signs[i];

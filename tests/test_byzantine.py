@@ -131,6 +131,98 @@ def test_corrupt_shares_detected_and_debited():
     assert sum(reasons) >= 1
 
 
+class PlusSharePeer(PeerAgent):
+    """Colluder A: +OFFSET on every share row cell."""
+
+    OFFSET = 12345
+
+    def _secret_arrays(self, shares, blind_rows, comms, sl):
+        arrays = super()._secret_arrays(shares, blind_rows, comms, sl)
+        arrays["share_rows"] = arrays["share_rows"] + self.OFFSET
+        return arrays
+
+
+class MinusSharePeer(PlusSharePeer):
+    """Colluder B: −OFFSET, cancelling A inside any batch containing both."""
+
+    OFFSET = -12345
+
+
+class LyingListMiner(PeerAgent):
+    """Colluding miner: omits one colluder from its GetUpdateList response,
+    so the leader's agreed set covers the leader's intake batch only
+    partially — the split that would let the remaining colluder's
+    corruption reach the block if the aggregation boundary did not
+    re-verify."""
+
+    OMIT = -1
+
+    async def _h_get_update_list(self, meta, arrays):
+        rmeta, arrs = await super()._h_get_update_list(meta, arrays)
+        rmeta["sources"] = [s for s in rmeta["sources"] if s != self.OMIT]
+        return rmeta, arrs
+
+
+def test_colluding_cancellation_caught_at_aggregation_boundary():
+    """Coalition attack on the aggregated VSS check (docs
+    §aggregated-vss whole-batch condition): workers B (+e) and C (−e)
+    cancel inside every miner's intake batch, and a colluding miner lies
+    C out of the agreed set. Without the aggregation-boundary re-check
+    the leader would serve/mint an aggregate shifted by e; with it, the
+    partial-batch re-proof isolates B, debits it with leader evidence,
+    and the block carries only honest updates."""
+    n, port = 7, 25070
+    chain = Blockchain(50, n, 10)
+    verifiers, miners = R.elect_committees(
+        chain.latest_stake_map(), chain.latest_hash(), 1, 2, n)
+    busy = set(verifiers) | set(miners)
+    workers = sorted(i for i in range(n) if i not in busy)
+    assert len(workers) >= 3, "need two colluders and an honest worker"
+    plus_id, minus_id = workers[0], workers[1]
+    liar_id = min(miners)          # the NON-leader miner lies
+    leader_id = max(miners)
+    assert liar_id != leader_id
+
+    LyingListMiner.OMIT = minus_id
+    cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
+                 defense=Defense.NONE, max_iterations=1, num_miners=2)
+            for i in range(n)]
+
+    async def go():
+        def mk(c):
+            if c.node_id == plus_id:
+                return PlusSharePeer(c)
+            if c.node_id == minus_id:
+                return MinusSharePeer(c)
+            if c.node_id == liar_id:
+                return LyingListMiner(c)
+            return PeerAgent(c)
+
+        agents = [mk(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    results, agents = asyncio.run(go())
+    byz = {plus_id, minus_id, liar_id}
+    honest = [r for r, a in zip(results, agents) if a.id not in byz]
+    dumps = [r["chain_dump"] for r in honest]
+    assert all(d == dumps[0] for d in dumps), "chain-equality oracle violated"
+    ch = next(a for a in agents if a.id not in byz).chain
+    accepted = [u.source_id for b in ch.blocks for u in b.data.deltas
+                if u.accepted]
+    rejected = [u.source_id for b in ch.blocks for u in b.data.deltas
+                if not u.accepted]
+    assert plus_id in rejected, (
+        "remaining colluder was not caught by the boundary re-check")
+    assert plus_id not in accepted
+    assert minus_id not in accepted, "lied-out colluder entered the block"
+    assert any(w in accepted for w in workers[2:]), (
+        "no honest update made it into the block")
+    final_stake = ch.latest_stake_map()
+    assert final_stake[plus_id] < cfgs[0].default_stake, (
+        "colluder stake was not debited")
+
+
 def test_forged_commitment_detected_and_debited():
     n, port = 5, 25020
     byz = _round0_vanilla(n)

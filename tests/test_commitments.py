@@ -181,6 +181,141 @@ def test_vss_verify_native_and_python_paths_agree(monkeypatch):
     assert not native_res["noncanonical_blind"]
 
 
+def test_h_byte_comb_mode_bit_identical():
+    """BISCOTTI_H_COMB=byte (the ~1 MB memory opt-down for many-process
+    clusters, docs/NATIVE_CRYPTO.md) must produce byte-identical Pedersen
+    commitments to the default 16-bit H comb. Env is read once per
+    process, so the variant runs in a subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from biscotti_tpu.crypto import commitments as cm\n"
+        "from biscotti_tpu.ops import secretshare as ss\n"
+        "d, k = 64, 10\n"
+        "c = ss.num_chunks(d, k)\n"
+        "q = np.arange(d, dtype=np.int64) - 30\n"
+        "padded = np.zeros(c * k, np.int64); padded[:d] = q\n"
+        "comms, _ = cm.vss_commit_chunks(padded.reshape(c, k), b's' * 32,"
+        " b'ctx')\n"
+        "print(comms.tobytes().hex())\n"
+    )
+    env = dict(os.environ, BISCOTTI_H_COMB="byte")
+    got = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, timeout=300)
+    assert got.returncode == 0, got.stderr
+
+    import numpy as np
+
+    from biscotti_tpu.crypto import commitments as cm
+    from biscotti_tpu.ops import secretshare as ss
+
+    d, k = 64, 10
+    c = ss.num_chunks(d, k)
+    q = np.arange(d, dtype=np.int64) - 30
+    padded = np.zeros(c * k, np.int64)
+    padded[:d] = q
+    comms, _ = cm.vss_commit_chunks(padded.reshape(c, k), b"s" * 32, b"ctx")
+    assert got.stdout.strip() == comms.tobytes().hex()
+
+
+def test_vss_verify_aggregated_group_semantics(monkeypatch):
+    """The aggregated round-intake check (instances sharing one xs/chunk
+    grid collapse to ONE summed-commitment MSM): honest groups pass, any
+    single inconsistent share fails the group and is identified by the
+    exact single-instance call, an off-curve commitment point anywhere in
+    the group is rejected, and the DOCUMENTED residual — a coalition
+    corrupting the same cell with cancelling errors — is accepted because
+    the recovered aggregate is unchanged. Native and python paths agree
+    throughout."""
+    import numpy as np
+
+    from biscotti_tpu.crypto import _native
+    from biscotti_tpu.crypto import commitments as cmx
+    from biscotti_tpu.ops import secretshare as ssx
+
+    d, k, total = 64, 10, 20
+    rng = np.random.RandomState(11)
+    c = ssx.num_chunks(d, k)
+    xs = [i - ssx.SHARE_OFFSET for i in range(total)][:5]
+    insts = []
+    for w in range(4):
+        q = rng.randint(-10**4, 10**4, d).astype(np.int64)
+        padded = np.zeros(c * k, np.int64)
+        padded[:d] = q
+        comms, blinds = cmx.vss_commit_chunks(padded.reshape(c, k),
+                                              bytes([w]) * 32, b"ctx")
+        br = cmx.vss_blind_rows(blinds, xs)
+        rows = np.asarray(ssx.make_shares(q, k, total))[:5]
+        insts.append((comms, xs, rows, br))
+
+    def clone():
+        return [(co.copy(), x, r.copy(), b.copy()) for co, x, r, b in insts]
+
+    one_bad = clone()
+    one_bad[2][2][1, 3] += 9
+    off_curve = clone()
+    off_curve[1][0][0, 0, 7] ^= 0x55
+    collude = clone()
+    collude[0][2][2, 4] += 5
+    collude[3][2][2, 4] -= 5
+
+    entropy = bytes(range(256)) * (16 * len(xs) * c * len(insts) // 256 + 1)
+
+    def run(cases):
+        return {
+            "honest": cmx.vss_verify_multi(insts, entropy=entropy),
+            "one_bad": cmx.vss_verify_multi(one_bad, entropy=entropy),
+            "identify": [cmx.vss_verify_multi([i], entropy=entropy)
+                         for i in one_bad],
+            "off_curve": cmx.vss_verify_multi(off_curve, entropy=entropy),
+            "collude_cancel": cmx.vss_verify_multi(collude, entropy=entropy),
+            # the whole-batch condition (docs §aggregated-vss): drop one
+            # colluder from the set and the cancellation breaks — this is
+            # exactly the re-check the runtime performs at the aggregation
+            # boundary when a served set covers a batch only partially
+            "collude_partial": cmx.vss_verify_multi(collude[:3],
+                                                    entropy=entropy),
+        }
+
+    assert _native.available()
+    native_res = run(insts)
+    monkeypatch.setattr(_native, "available", lambda: False)
+    python_res = run(insts)
+    assert native_res == python_res, (native_res, python_res)
+    assert native_res["honest"] is True
+    assert not native_res["one_bad"]
+    assert native_res["identify"] == [True, True, False, True]
+    assert not native_res["off_curve"]
+    # the residual acceptance: errors cancelling within one cell across a
+    # coalition — harmless for the WHOLE-group aggregate (recovery is
+    # exact); partial sets break the cancellation and are refused, which
+    # is what PeerAgent._ensure_subset_consistent relies on
+    assert native_res["collude_cancel"] is True
+    assert not native_res["collude_partial"]
+
+
+def test_partial_batch_members():
+    """The aggregation-boundary decision rule: members of batches fully
+    covered by the served set need no re-check; members of partially
+    covered (or unknown) batches do."""
+    from biscotti_tpu.runtime.peer import partial_batch_members
+
+    b1 = frozenset({1, 2, 3})
+    b2 = frozenset({4})
+    batches = {1: b1, 2: b1, 3: b1, 4: b2}
+    # whole batches: nothing to re-check
+    assert partial_batch_members(batches, [1, 2, 3, 4]) == []
+    assert partial_batch_members(batches, [4]) == []
+    # partial batch: exactly its included members re-check
+    assert partial_batch_members(batches, [1, 2, 4]) == [1, 2]
+    # unknown sid is conservatively re-checked
+    assert partial_batch_members(batches, [1, 2, 3, 9]) == [9]
+
+
 def test_torsioned_pubkey_single_and_batch_verdicts_agree():
     """Schnorr verification is COFACTORED over torsion-cleared points
     (see commitments._clear8): for a public key outside the prime-order
