@@ -467,7 +467,10 @@ class PeerAgent:
 
     async def _h_advertise_block(self, meta, arrays):
         """Header-only gossip: pull the body from the advertiser iff we do
-        not already hold this block (see _gossip_block)."""
+        not already hold this block (see _gossip_block). An advert AHEAD
+        of our round means we also miss ancestors (a lost broadcast frame
+        for an earlier block) — a single-height pull could not extend the
+        chain, so catch up block-by-block from the advertiser instead."""
         it = int(meta["iteration"])
         h = bytes.fromhex(meta.get("hash", ""))
         src = int(meta.get("source_id", -1))
@@ -475,6 +478,9 @@ class PeerAgent:
         if have is not None and have.hash == h:
             return {}, {}
         if src not in self.peers:
+            return {}, {}
+        if it > self.iteration:
+            self._schedule_catch_up(src)
             return {}, {}
 
         async def pull():
@@ -547,7 +553,13 @@ class PeerAgent:
         (iteration, hash) header to a log-sized random subset, and anyone
         missing the block pulls it. Same epidemic coverage, but the body
         crosses the wire O(N) times instead of O(N·fanout)."""
-        targets = [pid for pid in self.alive if pid != self.id]
+        # deliver to the FULL membership, not the alive subset: `alive` is
+        # a liveness heuristic evicted on any transient RPC timeout, and a
+        # quiet worker that never calls us back would otherwise drop out of
+        # every gossip target draw and strand on its block timer (observed
+        # at N=50+ under load). A truly dead target costs one fast failed
+        # dial; a mislabeled live one gets its block.
+        targets = [pid for pid in self.peers if pid != self.id]
         if full:
             from biscotti_tpu.runtime import messages as msgs
 
@@ -1470,10 +1482,37 @@ class PeerAgent:
             self._empty_fallbacks = 0
         except asyncio.TimeoutError:
             if self.iteration == it:
-                self._trace("block_timeout_empty_fallback")
-                self._empty_fallbacks = getattr(self, "_empty_fallbacks", 0) + 1
-                self._accept_block(self._empty_block(), gossip=True,
-                                   minted=True)
+                # before minting an empty block, try pulling the round's
+                # block from a few peers — if the network minted one and
+                # only our copy of the gossip was lost, this re-joins the
+                # consensus chain instead of forking onto an empty one
+                pulled = False
+                candidates = [p for p in self.peers if p != self.id]
+                for pid in self._rng.sample(candidates,
+                                            min(3, len(candidates))):
+                    try:
+                        bmeta, barrays = await self._call(
+                            pid, "GetBlock", {"iteration": it},
+                            timeout=min(5.0, self.timeouts.rpc_s))
+                        blk = wire.unpack_block(bmeta, barrays)
+                        if blk.hash == blk.compute_hash():
+                            self._accept_block(blk, gossip=True)
+                            if self.iteration != it:
+                                self._trace("block_timeout_pull_recovered")
+                                # a successful pull is proof of connectivity
+                                # — don't let earlier fallbacks accumulate
+                                # into a spurious isolation re-announce
+                                self._empty_fallbacks = 0
+                                pulled = True
+                                break
+                    except Exception:
+                        continue
+                if not pulled and self.iteration == it:
+                    self._trace("block_timeout_empty_fallback")
+                    self._empty_fallbacks = getattr(
+                        self, "_empty_fallbacks", 0) + 1
+                    self._accept_block(self._empty_block(), gossip=True,
+                                       minted=True)
         if not st.krum_decision.done():
             st.krum_decision.set_result(set())
         for t in work:
@@ -1570,6 +1609,18 @@ class PeerAgent:
                 await asyncio.to_thread(ckpt.save, self.chain, self.ckpt_dir)
                 await asyncio.to_thread(ckpt.prune, self.ckpt_dir, 3)
         dump = self.chain.dump()
+        # Linger before tearing down: the FINAL round's block gossip has no
+        # later round to heal it — a peer that missed the push must pull
+        # the body from someone still serving GetBlock. Finish our own
+        # outbound gossip/advert tasks (bounded) and keep the server up for
+        # a short grace window so stragglers' pulls land; without this, a
+        # single dropped broadcast frame in the last round stranded peers
+        # on their 300 s block timer at N=100 while everyone who could have
+        # served the block had already exited.
+        if self._bg_tasks:
+            await asyncio.wait(list(self._bg_tasks),
+                               timeout=min(5.0, self.timeouts.rpc_s))
+        await asyncio.sleep(min(2.0, self.timeouts.rpc_s / 3))
         self.pool.close()
         await self.server.stop()
         if self._events:

@@ -144,6 +144,7 @@ class _Conn:
         self.pending: Dict[int, asyncio.Future] = {}
         self.next_rid = 1
         self.write_lock = asyncio.Lock()
+        self.sending = 0  # in-flight fire-and-forget writes (see _send)
         self.reader_task = asyncio.create_task(self._read_loop())
 
     async def _read_loop(self) -> None:
@@ -180,7 +181,12 @@ class _Conn:
         """Bounded write: a peer that stops draining (full receive buffer,
         long GIL hold) must not wedge the write lock forever — on timeout
         the connection is torn down so queued callers fail fast and the
-        next use redials."""
+        next use redials. `sending` marks the conn busy for the pool's LRU
+        eviction: fire-and-forget posts (rid 0) never register in
+        `pending`, so without it a broadcast fanning out past the pool cap
+        evicts its own conns MID-DRAIN and silently drops frames — at
+        N=100 that lost the minted block for every peer beyond the cap."""
+        self.sending += 1
         try:
             async with self.write_lock:
                 self.writer.write(frame)
@@ -191,6 +197,8 @@ class _Conn:
         except ConnectionError:
             self.close()
             raise
+        finally:
+            self.sending -= 1
 
     async def roundtrip(self, msg_type, meta, arrays, timeout):
         rid = self.next_rid
@@ -225,12 +233,19 @@ class Pool:
     least-recently-used connections are closed beyond `max_conns`;
     in-flight ones are never evicted, and the next use simply redials."""
 
-    def __init__(self, max_conns: int = 32):
+    def __init__(self, max_conns: int = 32,
+                 latency: Optional[Callable[[str, int], float]] = None):
         from collections import OrderedDict
 
         self._conns: "OrderedDict[Tuple[str, int], _Conn]" = OrderedDict()
         self._dialing: Dict[Tuple[str, int], asyncio.Task] = {}
         self._max = max_conns
+        # Optional per-link latency model (host, port) -> seconds, applied
+        # to every call/post toward that link: the WAN/geo harness runs
+        # loopback clusters with the reference's multi-region operating
+        # point (ref: global-deploy-eval, multi-DC Azure) by charging each
+        # cross-"region" RPC its round-trip here. None = loopback (no-op).
+        self.latency = latency
 
     def _evict(self, exempt: Optional[Tuple[str, int]] = None) -> None:
         # drop dead connections regardless of the cap, then close idle
@@ -250,7 +265,7 @@ class Pool:
             if k == exempt:
                 continue
             c = self._conns[k]
-            if c.pending:
+            if c.pending or c.sending:
                 continue
             del self._conns[k]
             c.close()
@@ -288,6 +303,10 @@ class Pool:
         # the roundtrip a second full budget
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
+        if self.latency is not None:
+            d = self.latency(host, port)
+            if d > 0:  # request + reply each ride the link once
+                await asyncio.sleep(d)
         conn = await self._get(host, port, timeout)
         remaining = max(0.001, deadline - loop.time())
         rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays,
@@ -306,6 +325,10 @@ class Pool:
         multi-MB block was the event loop's dominant cost."""
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
+        if self.latency is not None:
+            d = self.latency(host, port)
+            if d > 0:
+                await asyncio.sleep(d / 2)  # one-way: no reply to wait for
         conn = await self._get(host, port, timeout)
         await conn._send(frame, max(0.001, deadline - loop.time()))
 
@@ -316,6 +339,26 @@ class Pool:
         for task in self._dialing.values():
             task.cancel()
         self._dialing.clear()
+
+
+def geo_latency(node_id: int, base_port: int, regions: int, n: int,
+                rtt_s: float) -> Callable[[str, int], float]:
+    """Per-link latency model for the WAN/geo operating point (assign the
+    result to Pool.latency): peers split into `regions` contiguous blocks
+    ("datacenters"); an RPC whose two ends sit in different regions pays
+    the cross-region round trip, intra-region traffic stays
+    loopback-fast. Mirrors the reference's multi-DC Azure deployment
+    (ref: global-deploy-eval/biscottiParsedResults — 87.0 s/iter Biscotti
+    @ 100 nodes multi-region, BASELINE.md rows 8-11)."""
+    my_region = node_id * regions // n
+
+    def lat(host: str, port: int) -> float:
+        peer = port - base_port
+        if not (0 <= peer < n):
+            return 0.0
+        return rtt_s if (peer * regions // n) != my_region else 0.0
+
+    return lat
 
 
 async def call(host: str, port: int, msg_type: str,

@@ -43,46 +43,78 @@ def main(argv=None) -> int:
     from biscotti_tpu.config import BiscottiConfig, Defense
     from biscotti_tpu.parallel.sim import Simulator
 
+    # Two sweeps, side by side:
+    #
+    # mode=model (dp_in_model): the noise is PART of the aggregated
+    # update — the configuration behind the reference's ε-accuracy curves
+    # (ref: DistSys/mnist_batch_350_epsilon_*.png, honest.go:172-179).
+    # Utility degrades directly with ε.
+    #
+    # mode=committee (cfg.noising): the reference's privacy_utility_krum
+    # experiment semantics (ref: eval/eval_privacy_utility_krum/
+    # runEval.sh:4-9 runs `-np=false -ep=<eps>` — committee noising ON).
+    # Noise shields each update in transit and CANCELS in the aggregate,
+    # but verifiers judge the NOISED copies (ref: main.go:1592-1660;
+    # sim.py routes defense_mask over `noised`), so ε shapes which
+    # updates Krum accepts — the indirect utility cost the model-noise
+    # sweep cannot see.
+    import numpy as np
+
     rows = []
-    for eps in EPSILONS:
-        noising = not math.isinf(eps)
-        # dp_in_model: the noise is PART of the aggregated update, the
-        # configuration behind the reference's ε-accuracy curves
-        # (ref: DistSys/mnist_batch_350_epsilon_*.png, honest.go:172-179).
-        # Committee noising (cfg.noising) would leave the aggregate exact —
-        # it protects transport privacy, not the model — and shows no
-        # utility loss by design.
-        cfg = BiscottiConfig(
-            dataset=args.dataset, num_nodes=args.nodes,
-            epsilon=eps if noising else 1.0, dp_in_model=noising,
-            noising=False, verification=True, defense=Defense.KRUM,
-            sample_percent=0.70, seed=1,
-        )
-        sim = Simulator(cfg)
-        w, stake, errs, accepted = sim.run_scan(args.rounds)
-        row = {
-            "epsilon": "inf" if math.isinf(eps) else eps,
-            "final_error": round(float(errs[-1]), 4),
-            "best_error": round(float(errs.min()), 4),
-            "attack_rate": round(sim.attack_rate(w), 4),
-        }
-        rows.append(row)
-        print(json.dumps(row))
+    inf_row = None  # the eps=inf cell is mode-independent: compute once
+    for mode in ("model", "committee"):
+        for eps in EPSILONS:
+            noisy = not math.isinf(eps)
+            if not noisy and inf_row is not None:
+                row = dict(inf_row, mode=mode)
+                rows.append(row)
+                print(json.dumps(row))
+                continue
+            cfg = BiscottiConfig(
+                dataset=args.dataset, num_nodes=args.nodes,
+                epsilon=eps if noisy else 1.0,
+                dp_in_model=noisy and mode == "model",
+                noising=noisy and mode == "committee",
+                verification=True, defense=Defense.KRUM,
+                sample_percent=0.70, seed=1,
+            )
+            sim = Simulator(cfg)
+            w, stake, errs, accepted = sim.run_scan(args.rounds)
+            row = {
+                "mode": mode,
+                "epsilon": "inf" if math.isinf(eps) else eps,
+                "final_error": round(float(errs[-1]), 4),
+                "best_error": round(float(errs.min()), 4),
+                "attack_rate": round(sim.attack_rate(w), 4),
+                "mean_accepted": round(float(np.mean(accepted)), 2),
+            }
+            if not noisy:
+                inf_row = row
+            rows.append(row)
+            print(json.dumps(row))
 
     os.makedirs(args.out, exist_ok=True)
     with open(os.path.join(args.out, "privacy_utility.csv"), "w") as f:
-        f.write("epsilon,final_error,best_error,attack_rate\n")
+        f.write("mode,epsilon,final_error,best_error,attack_rate,"
+                "mean_accepted\n")
         for r in rows:
-            f.write(f"{r['epsilon']},{r['final_error']},{r['best_error']},"
-                    f"{r['attack_rate']}\n")
+            f.write(f"{r['mode']},{r['epsilon']},{r['final_error']},"
+                    f"{r['best_error']},{r['attack_rate']},"
+                    f"{r['mean_accepted']}\n")
     with open(os.path.join(args.out, "privacy_utility.json"), "w") as f:
         json.dump({"experiment": "privacy_utility", "dataset": args.dataset,
                    "nodes": args.nodes, "rounds": args.rounds, "rows": rows,
                    "data_note": "synthetic shards (zero-egress env)"},
                   f, indent=1)
-    # utility must degrade monotonically-ish as ε shrinks: the strictest
-    # privacy cell must not beat the no-noise cell
-    ok = rows[0]["final_error"] >= rows[-1]["final_error"]
+    model_rows = [r for r in rows if r["mode"] == "model"]
+    comm_rows = [r for r in rows if r["mode"] == "committee"]
+    # model-noise utility must degrade monotonically-ish as ε shrinks: the
+    # strictest privacy cell must not beat the no-noise cell
+    ok = model_rows[0]["final_error"] >= model_rows[-1]["final_error"]
+    # committee noise leaves accepted aggregates exact, so even the
+    # strictest ε must stay FAR below the model-noise error at the same ε
+    # (the cost shows up in Krum's accept set instead)
+    ok = ok and comm_rows[0]["final_error"] <= model_rows[0]["final_error"]
     print(json.dumps({"summary": "noise_costs_utility", "ok": ok}))
     return 0 if ok else 1
 

@@ -161,3 +161,46 @@ def test_partition_window_heals_and_chain_matches():
     assert common >= 2
     assert maj[:common] == mino[:common], (
         f"fork did not heal:\nmajority={maj}\nminority={mino}")
+
+
+def test_geo_latency_model_and_cluster():
+    """WAN/geo operating point (the reference's multi-DC deployment,
+    global-deploy-eval): the per-link latency model charges cross-region
+    RPCs only, and a latency-injected cluster still mints equal chains —
+    just slower than loopback."""
+    import time
+
+    from biscotti_tpu.runtime.rpc import geo_latency
+
+    # region math: 6 peers, 3 regions -> contiguous pairs
+    lat = geo_latency(node_id=0, base_port=9000, regions=3, n=6, rtt_s=0.08)
+    assert lat("h", 9001) == 0.0          # same region
+    assert lat("h", 9002) == 0.08         # next region
+    assert lat("h", 9005) == 0.08         # far region
+    assert lat("h", 9999) == 0.0          # out-of-range port: no charge
+
+    n, port, rtt = 4, 25240, 0.05
+
+    async def go(regions):
+        from biscotti_tpu.runtime.rpc import geo_latency as gl
+
+        agents = [PeerAgent(_cfg(i, n, port + 20 * regions))
+                  for i in range(n)]
+        if regions > 1:
+            for a in agents:
+                a.pool.latency = gl(a.id, a.cfg.base_port, regions, n, rtt)
+        t0 = time.monotonic()
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, time.monotonic() - t0
+
+    # baseline FIRST: it pays the one-time jit compile, so the geo run's
+    # extra wall-clock is attributable to the injected latency alone
+    results_base, wall_base = asyncio.run(go(1))
+    results_geo, wall_geo = asyncio.run(go(2))
+    for results in (results_geo, results_base):
+        dumps = [r["chain_dump"] for r in results]
+        assert all(d == dumps[0] for d in dumps)
+        assert any("ndeltas=0" not in ln
+                   for ln in dumps[0].splitlines()[1:])
+    # the injected WAN must actually cost wall-clock
+    assert wall_geo > wall_base, (wall_geo, wall_base)
