@@ -123,7 +123,13 @@ class BiscottiConfig:
     # Any r < 2 makes recovering subsets need > M/2 miners, so every pair
     # of them overlaps in a miner whose one-set-per-round guard then fires
     # (see _h_get_miner_part). r=1.5 still tolerates ⌊M/3⌋ dead miners.
-    share_redundancy: float = 2.0  # reference-parity default
+    #
+    # DEFAULT r=1.5 (hardened): the configuration people actually run gets
+    # the anti-differencing guarantee, not just the documented option.
+    # Layouts where ceil-rounding breaks the promise (e.g. M=10, k=10)
+    # fail loudly from total_shares — set r=2.0 explicitly for
+    # reference-parity fault tolerance there.
+    share_redundancy: float = 1.5
     max_iterations: int = 100  # MAX_ITERATIONS (main.go:48)
     fail_prob: float = 0.0  # random per-iteration self-crash (main.go:54-55)
     defense: Defense = Defense.KRUM  # POISON_DEFENSE (main.go:57)

@@ -136,6 +136,20 @@ def _real_corpus(dataset: str) -> Tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(x[order]), np.ascontiguousarray(y[order])
 
 
+def disjoint_shard_capacity(dataset: str) -> "int | None":
+    """How many peers can hold fully DISJOINT shards of a REAL corpus
+    (None for synthetic datasets, which generate per-peer data freely).
+    Beyond this count `_draw`'s wrap-around reuses overlapping slices —
+    callers reporting defense statistics should disclose that (a poisoned
+    peer's shard may coincide with an honest peer's). Single source of
+    truth for the slicing math in `_draw` below."""
+    s = _spec(dataset)
+    if not s.real:
+        return None
+    corpus_n = len(_real_corpus(dataset)[0])
+    return max(1, (corpus_n - s.test_size) // s.shard_size)
+
+
 def _draw(dataset: str, tag: str, n: int) -> Tuple[np.ndarray, np.ndarray]:
     s = _spec(dataset)
     if s.real:

@@ -77,6 +77,13 @@ def _keyless_pubs(seed: int, node: int) -> Tuple[bytes, bytes]:
     return _keyless_pub_cache[key]
 
 
+def _decline_message(iteration: int, sid: int) -> bytes:
+    """Domain-separated payload a rejected worker signs to tell miners it
+    will not contribute this round (see RoundState.miner_declined)."""
+    return (b"biscotti-decline|" + int(iteration).to_bytes(8, "little")
+            + int(sid).to_bytes(8, "little"))
+
+
 def partial_batch_members(batch_of: Dict[int, frozenset],
                           nodes: Sequence[int]) -> List[int]:
     """Sids in `nodes` whose verification batch is NOT fully contained in
@@ -132,6 +139,14 @@ class RoundState:
     # carried into the minted block as accepted=False records and debited
     # STAKE_UNIT (ref: honest.go:363-370 debits rejected block updates)
     miner_rejected: Dict[int, Update] = field(default_factory=dict)
+    # sampled workers that signed a DECLINE notice (their update was
+    # refused by the verifier committee, so they will not contribute):
+    # completes the miner's have+rejected >= NUM_SAMPLES mint condition,
+    # which otherwise can never fire when Krum approves fewer than the
+    # mint target (short pools accept pool − pool//2) and the round rides
+    # the full update deadline — observed as ~90 s stalls in ~4% of
+    # rounds at N=100
+    miner_declined: Set[int] = field(default_factory=set)
     # the one aggregation set this miner will serve this round: releasing
     # aggregates over a SECOND, different subset would let a malicious
     # leader difference the two sums and unmask an individual update
@@ -406,6 +421,7 @@ class PeerAgent:
             "GetBlock": self._h_get_block,
             "RegisterUpdate": self._h_register_update,
             "RegisterSecret": self._h_register_secret,
+            "RegisterDecline": self._h_register_decline,
             "RequestNoise": self._h_request_noise,
             "VerifyUpdateKRUM": self._h_verify_update,
             "VerifyUpdateRONI": self._h_verify_update,
@@ -643,6 +659,32 @@ class PeerAgent:
         st.miner_updates.setdefault(u.source_id, u)
         self._trace("update_registered", source=u.source_id,
                     have=len(st.miner_updates))
+        return {}, {}
+
+    async def _h_register_decline(self, meta, arrays):
+        """A sampled worker whose update the verifier committee refused
+        notifies the miners it will not contribute this round. The notice
+        only shrinks the expected-contributor count (it injects nothing),
+        and it must carry the worker's own Schnorr signature — otherwise
+        an attacker could decline OTHER peers into early, thin blocks."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        st = await self._wait_round_ready(it)
+        if not self.role_map.is_miner(self.id):
+            raise RPCError("not a miner this round")
+        sid = int(meta["source_id"])
+        if not self.role_map.is_vanilla(sid):
+            # only this round's WORKERS are expected contributors; a
+            # committee member's self-decline would inflate the accounted
+            # count and mint early, excluding in-flight honest updates
+            raise RPCError("decline from a non-contributor")
+        sig = bytes.fromhex(meta.get("sig", ""))
+        pub = self.node_pubs.get(sid)
+        if pub is None or not await asyncio.to_thread(
+                cm.schnorr_verify, pub, _decline_message(it, sid), sig):
+            raise RPCError("bad decline signature")
+        st.miner_declined.add(sid)
         return {}, {}
 
     async def _h_register_secret(self, meta, arrays):
@@ -1189,6 +1231,19 @@ class PeerAgent:
             u.signatures = [s for _, s in sigs]
         if not approved:
             self._trace("update_rejected")
+            # signed decline notice to the miners: completes their
+            # expected-contributor count so the round mints as soon as
+            # every sampled worker is accounted for, instead of riding
+            # the update deadline (see RoundState.miner_declined)
+            _, miners, _, _ = self.role_map.committee()
+            dmeta = {
+                "iteration": it, "source_id": self.id,
+                "sig": self._sign(_decline_message(it, self.id)).hex(),
+            }
+            await asyncio.gather(*(
+                self._safe_call(m, "RegisterDecline", dmeta)
+                for m in sorted(miners)
+            ))
             return
 
         _, miners, _, _ = self.role_map.committee()
@@ -1275,10 +1330,15 @@ class PeerAgent:
         t0 = time.monotonic()
         grace_until = None
         while time.monotonic() - t0 < deadline:
-            have = len(st.miner_shares) if sec else len(st.miner_updates)
-            # every expected contributor has responded (incl. provably bad
-            # submissions): mint at once
-            if have + len(st.miner_rejected) >= cfg.num_samples:
+            have_map = st.miner_shares if sec else st.miner_updates
+            have = len(have_map)
+            # every expected contributor has responded — a submission, a
+            # provably bad one, or a signed decline (verifier-refused
+            # workers, RegisterDecline): mint at once. Union-counted so a
+            # Byzantine worker both declining and submitting is one peer.
+            accounted = len(have_map.keys() | st.miner_rejected.keys()
+                            | st.miner_declined)
+            if accounted >= cfg.num_samples:
                 break
             if have >= target:
                 # quorum reached — hold a short straggler window so

@@ -61,10 +61,25 @@ def generate(dims: int, nodes: int, out_dir: str, host: str = "127.0.0.1",
             f.write(f"{host}:{base_port + i}\n")
 
 
+_commit_key_cache: dict = {}
+
+
 def load_commit_key(out_dir: str) -> CommitKey:
-    with open(os.path.join(out_dir, "commit_key.json")) as f:
+    """Parse commit_key.json once per (path, mtime) and share the result:
+    in-process clusters build one PeerAgent per node, and at d=7,850 a
+    per-agent parse cost N× the startup time of the whole cluster. The
+    key is immutable public data, so sharing the object is safe."""
+    path = os.path.join(out_dir, "commit_key.json")
+    stamp = (path, os.path.getmtime(path))
+    cached = _commit_key_cache.get(stamp)
+    if cached is not None:
+        return cached
+    with open(path) as f:
         data = json.load(f)
-    return CommitKey.deserialize(data["points"])
+    key = CommitKey.deserialize(data["points"])
+    _commit_key_cache.clear()  # at most one key per process lifetime
+    _commit_key_cache[stamp] = key
+    return key
 
 
 def load_node_keys(out_dir: str) -> dict:

@@ -51,6 +51,14 @@ def write_peers_file(hosts, nodes_per_host, base_port, out_path):
                 node_id += 1
 
 
+def committee_size(requested: int, total: int) -> int:
+    """Clamp a committee size so small fleets keep vanilla WORKERS: the
+    config's reference defaults (3 miners + 3 verifiers) would otherwise
+    swallow every node of a 4-peer fleet — zero updates, all-empty
+    blocks (the launcher's original silent failure mode)."""
+    return max(1, min(requested, total // 3))
+
+
 def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
     cmd = [sys.executable, "-m", "biscotti_tpu.runtime.peer",
            "-i", str(node_id), "-t", str(total),
@@ -59,6 +67,9 @@ def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
            "-p", str(args.base_port),
            "-sa", str(args.secure_agg), "-np", str(args.noising),
            "-vp", str(args.verification),
+           "-na", str(committee_size(args.num_miners, total)),
+           "-nv", str(committee_size(args.num_verifiers, total)),
+           "-nn", str(committee_size(args.num_noisers, total)),
            "--max-iterations", str(args.iterations),
            "--seed", str(args.seed)]
     if args.key_dir:
@@ -79,10 +90,20 @@ def main(argv=None) -> int:
     ap.add_argument("--noising", type=int, default=0)
     ap.add_argument("--verification", type=int, default=1)
     ap.add_argument("--key-dir", default="")
+    ap.add_argument("--num-miners", type=int, default=3)
+    ap.add_argument("--num-verifiers", type=int, default=3)
+    ap.add_argument("--num-noisers", type=int, default=2)
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--peers-file", default="/tmp/biscotti_peers.txt")
     ap.add_argument("--dry-run", action="store_true")
     ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--ssh-cmd", default="ssh",
+                    help="remote-exec command (shlex-split); swap for "
+                         "'python -m biscotti_tpu.tools.sshim' to drive "
+                         "the remote branch on a box with no ssh client")
+    ap.add_argument("--scp-cmd", default="scp",
+                    help="file-distribution command (shlex-split); pair "
+                         "with --ssh-cmd's sshim: '... sshim --scp'")
     args = ap.parse_args(argv)
 
     hosts = read_hosts(args.hosts)
@@ -98,7 +119,8 @@ def main(argv=None) -> int:
         if args.key_dir:
             copies.append((args.key_dir, args.key_dir, ["-r"]))
         for src, dst, flags in copies:
-            scp = ["scp", "-q", *flags, src, f"{h}:{dst}"]
+            scp = [*shlex.split(args.scp_cmd), "-q", *flags, src,
+                   f"{h}:{dst}"]
             if args.dry_run:
                 print(f"[scp]   {' '.join(shlex.quote(c) for c in scp)}")
                 continue
@@ -129,7 +151,7 @@ def main(argv=None) -> int:
             else:
                 remote = (f"cd {shlex.quote(REPO)} && JAX_PLATFORMS=cpu "
                           f"{' '.join(map(shlex.quote, cmd))}")
-                ssh = ["ssh", h, remote]
+                ssh = [*shlex.split(args.ssh_cmd), h, remote]
                 if args.dry_run:
                     print(f"[ssh]   {' '.join(map(shlex.quote, ssh))}")
                 else:

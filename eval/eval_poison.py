@@ -36,6 +36,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rounds", type=int, default=100)
     ap.add_argument("--epsilon", type=float, default=1.0)
     ap.add_argument("--out", default="eval/results")
+    ap.add_argument("--tag", default="",
+                    help="artifact stem override (e.g. poison_digits_100), "
+                         "so variant runs never clobber the canonical "
+                         "artifacts")
     ap.add_argument("--platform", default="")
     args = ap.parse_args(argv)
     if args.platform:
@@ -75,20 +79,34 @@ def main(argv=None) -> int:
     os.makedirs(args.out, exist_ok=True)
     # mnist keeps the historical bare names; other datasets get a suffix so
     # real-data runs (digits/cancer) sit alongside the synthetic artifacts
-    stem = "poison" if args.dataset == "mnist" else f"poison_{args.dataset}"
+    stem = args.tag or ("poison" if args.dataset == "mnist"
+                        else f"poison_{args.dataset}")
     with open(os.path.join(args.out, f"{stem}.csv"), "w") as f:
         f.write("poison,defense,final_error,attack_rate,mean_accepted\n")
         for r in rows:
             f.write(f"{r['poison']},{r['defense']},{r['final_error']},"
                     f"{r['attack_rate']},{r['mean_accepted']}\n")
+    from biscotti_tpu.data.datasets import disjoint_shard_capacity
+
+    spec = DATASETS[args.dataset]
+    capacity = disjoint_shard_capacity(args.dataset)
     summary = {
         "experiment": "poison",
         "dataset": args.dataset, "nodes": args.nodes, "rounds": args.rounds,
         "rows": rows,
         "data_note": ("REAL data (sklearn-bundled corpus)"
-                      if DATASETS[args.dataset].real
+                      if spec.real
                       else "synthetic shards (zero-egress env)"),
     }
+    if capacity is not None and args.nodes > capacity:
+        summary["shard_note"] = (
+            f"corpus supports ~{capacity} disjoint shards; at nodes="
+            f"{args.nodes} peers REUSE overlapping slices, so a poisoned "
+            f"peer's shard may coincide with an honest peer's — Krum "
+            f"separation statistics are only meaningful at nodes<="
+            f"{capacity} (see poison_{args.dataset}.json for the disjoint "
+            f"run); this run validates protocol behavior at scale, not "
+            f"defense statistics")
     with open(os.path.join(args.out, f"{stem}.json"), "w") as f:
         json.dump(summary, f, indent=1)
     # the defense must actually defend at the reference's operating point

@@ -34,9 +34,16 @@ def test_collusion_threshold_percentage():
 
 
 def test_total_shares_formula():
+    # hardened default r=1.5 (anti-differencing holds out of the box)
     c = _cfg(poly_size=10, num_miners=3)
-    assert c.total_shares == 21 and c.shares_per_miner == 7
+    assert c.total_shares == 15 and c.shares_per_miner == 5
+    assert c.shares_per_miner * (c.num_miners // 2) < c.poly_size
     c = _cfg(poly_size=10, num_miners=4)
+    assert c.total_shares == 16 and c.shares_per_miner == 4
+    # reference-parity r=2 on request (main.go:825)
+    c = _cfg(poly_size=10, num_miners=3, share_redundancy=2.0)
+    assert c.total_shares == 21 and c.shares_per_miner == 7
+    c = _cfg(poly_size=10, num_miners=4, share_redundancy=2.0)
     assert c.total_shares == 20 and c.shares_per_miner == 5
 
 
@@ -63,5 +70,8 @@ def test_share_redundancy_guarantee_is_validated():
         _ = BiscottiConfig(share_redundancy=1.9, num_miners=10).total_shares
     with pytest.raises(ValueError, match="recovery impossible"):
         _ = BiscottiConfig(share_redundancy=0.5, num_miners=3).total_shares
-    # the reference-parity default is unchanged
-    assert BiscottiConfig(num_miners=3).total_shares == 21
+    # the DEFAULT is the hardened r=1.5: the anti-differencing structural
+    # property holds in the configuration people actually run
+    dflt = BiscottiConfig(num_miners=3)
+    assert dflt.total_shares == 15
+    assert dflt.shares_per_miner * (dflt.num_miners // 2) < dflt.poly_size

@@ -204,3 +204,60 @@ def test_geo_latency_model_and_cluster():
                    for ln in dumps[0].splitlines()[1:])
     # the injected WAN must actually cost wall-clock
     assert wall_geo > wall_base, (wall_geo, wall_base)
+
+
+class VetoedWorker(PeerAgent):
+    """Worker whose verify requests all fail — its update is never
+    approved, so it must take the signed-decline path."""
+
+    async def _call(self, pid, msg_type, meta=None, arrays=None,
+                    timeout=None):
+        if msg_type.startswith("VerifyUpdate"):
+            raise StaleError("synthetic veto")
+        return await super()._call(pid, msg_type, meta, arrays, timeout)
+
+
+def test_declines_complete_the_mint_condition():
+    """When the verifier committee approves fewer workers than the mint
+    target (short pools accept pool − pool//2), the leader's completeness
+    condition have+rejected >= NUM_SAMPLES can only fire because refused
+    workers send signed DECLINE notices — without them the round rides
+    the full update deadline (observed as ~90 s stalls at N=100). Here 4
+    of 5 workers are vetoed: the round must still mint the lone accepted
+    update well before the 25 s deadline."""
+    import time
+
+    from biscotti_tpu.config import Timeouts
+
+    n, port = 7, 25280  # disjoint from the geo test's 25240-25263 block
+    slow = Timeouts(update_s=25.0, block_s=40.0, krum_s=3.0, share_s=25.0,
+                    rpc_s=6.0)
+    from biscotti_tpu.ledger.chain import Blockchain
+    from biscotti_tpu.parallel import roles as R
+
+    chain = Blockchain(50, n, 10)
+    verifiers, miners = R.elect_committees(
+        chain.latest_stake_map(), chain.latest_hash(), 1, 1, n)
+    workers = [i for i in range(n)
+               if i not in set(verifiers) | set(miners)]
+    vetoed = set(workers[:4])
+
+    async def go():
+        agents = [
+            (VetoedWorker if i in vetoed else PeerAgent)(
+                _cfg(i, n, port, max_iterations=1, verification=1,
+                     timeouts=slow))
+            for i in range(n)
+        ]
+        t0 = time.monotonic()
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, time.monotonic() - t0
+
+    results, wall = asyncio.run(go())
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+    assert any("ndeltas=0" not in ln for ln in dumps[0].splitlines()[1:]), \
+        "no real block minted"
+    # krum decides at ~3 s (short pool), declines land within ~1 s; the
+    # mint must follow promptly instead of riding the 25 s update deadline
+    assert wall < 15.0, f"round rode the deadline: wall={wall:.1f}s"

@@ -41,3 +41,30 @@ def test_dry_run_plans_scp_ssh_and_local(tmp_path, capsys):
     summary = json.loads(out.splitlines()[-1])
     assert summary == {"dry_run": True, "total_nodes": 2, "hosts": 2,
                        "peers_file": str(tmp_path / "peers.txt")}
+
+
+def test_remote_branch_executes_end_to_end_via_sshim(tmp_path, capsys):
+    """The launcher's REMOTE code path — scp distribution, per-host ssh
+    launch, output collection, chain-equality oracle — executed for real,
+    with only the transport swapped for the local sshim stand-in (this
+    image ships no ssh client). The '127.0.0.1' host entry is != the
+    literal 'localhost', so it takes the ssh branch while its peers stay
+    dialable (ref: azure/azure-run/runBiscotti.sh:1-100)."""
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("localhost\n127.0.0.1\n")
+    peers = tmp_path / "peers.txt"
+    rc = pod_launch.main([
+        "--hosts", str(hosts), "--nodes-per-host", "2",
+        "--dataset", "creditcard", "--iterations", "1",
+        "--base-port", "25610",
+        "--peers-file", str(peers),
+        "--ssh-cmd", "python -m biscotti_tpu.tools.sshim",
+        "--scp-cmd", "python -m biscotti_tpu.tools.sshim --scp",
+        "--timeout", "240",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    summary = json.loads(out.splitlines()[-1])
+    assert summary["chains_equal"] is True
+    assert summary["total_nodes"] == 4
+    assert summary["blocks"] >= 1
