@@ -61,6 +61,24 @@ def generate(dims: int, nodes: int, out_dir: str, host: str = "127.0.0.1",
             f.write(f"{host}:{base_port + i}\n")
 
 
+def make_ephemeral_dir(dataset: str, nodes: int,
+                       model_name: str = "") -> str:
+    """Generate a dealer key dir in a fresh temp directory sized for this
+    dataset's model dims — the shared bootstrap for eval harnesses
+    (eval/scale_test.py --key-dir auto, eval/eval_committee_scale.py)."""
+    import sys
+    import tempfile
+
+    from biscotti_tpu.models.zoo import model_for_dataset
+
+    dims = model_for_dataset(dataset, model_name or "").num_params
+    out_dir = tempfile.mkdtemp(prefix="biscotti_keys_")
+    print(f"[keygen] dealer keys: dims={dims} nodes={nodes} -> {out_dir}",
+          file=sys.stderr)
+    generate(dims=dims, nodes=nodes, out_dir=out_dir)
+    return out_dir
+
+
 _commit_key_cache: dict = {}
 
 

@@ -46,6 +46,25 @@ def build_cfgs(args):
     timeouts = Timeouts().scaled(
         args.nodes, args.num_verifiers, args.num_miners,
         defense_is_krum=args.defense == "KRUM")
+    extra = {}
+    if args.share_redundancy == "auto":
+        # single source of truth: probe the EXACT config this run builds;
+        # fall back to reference parity (r=2.0) only if its total_shares
+        # guarantee check rejects the hardened default
+        try:
+            _probe = BiscottiConfig(
+                node_id=0, num_nodes=args.nodes, dataset=args.dataset,
+                num_miners=args.num_miners,
+                num_verifiers=args.num_verifiers,
+                num_noisers=args.num_noisers)
+            _probe.total_shares
+        except ValueError:
+            print("[scale] share_redundancy=auto: hardened default "
+                  "unavailable for this committee shape, using r=2.0",
+                  file=sys.stderr)
+            extra["share_redundancy"] = 2.0
+    elif args.share_redundancy is not None:
+        extra["share_redundancy"] = float(args.share_redundancy)
     cfgs = []
     for i in range(args.nodes):
         cfgs.append(BiscottiConfig(
@@ -59,7 +78,7 @@ def build_cfgs(args):
             epsilon=args.epsilon, poison_fraction=args.poison,
             max_iterations=args.iterations, convergence_error=0.0,
             sample_percent=args.sample_percent, seed=args.seed,
-            timeouts=timeouts,
+            timeouts=timeouts, **extra,
         ))
     return cfgs
 
@@ -104,6 +123,13 @@ def main(argv=None) -> int:
     ap.add_argument("--num-verifiers", type=int, default=3)
     ap.add_argument("--num-noisers", type=int, default=2)
     ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--share-redundancy", default=None,
+                    help="a float overrides the config default (1.5 "
+                         "hardened); 'auto' keeps the default where its "
+                         "anti-differencing guarantee holds and falls "
+                         "back to the reference's r=2.0 for committee "
+                         "shapes where it is structurally unavailable "
+                         "(config.py total_shares)")
     ap.add_argument("--out", default="")
     ap.add_argument("--tag", default="")
     ap.add_argument("--log-dir", default="")
@@ -135,16 +161,9 @@ def main(argv=None) -> int:
     cfgs = build_cfgs(args)
     key_dir = args.key_dir
     if key_dir == "auto":
-        import tempfile
-
-        from biscotti_tpu.models.zoo import model_for_dataset
         from biscotti_tpu.tools import keygen
 
-        dims = model_for_dataset(args.dataset).num_params
-        key_dir = tempfile.mkdtemp(prefix="biscotti_keys_")
-        print(f"[scale] generating dealer keys: dims={dims} "
-              f"nodes={args.nodes} -> {key_dir}", file=sys.stderr)
-        keygen.generate(dims=dims, nodes=args.nodes, out_dir=key_dir)
+        key_dir = keygen.make_ephemeral_dir(args.dataset, args.nodes)
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     agents, results, wall = asyncio.run(
@@ -167,6 +186,8 @@ def main(argv=None) -> int:
     else:
         s_per_iter = wall / max(1, n_blocks)
 
+    from biscotti_tpu.data.datasets import DATASETS
+
     mode = "fedsys" if args.fedsys else "biscotti"
     summary = {
         "mode": mode, "nodes": args.nodes, "dataset": args.dataset,
@@ -187,8 +208,12 @@ def main(argv=None) -> int:
         "chains_equal": equal, "wall_s": round(wall, 2),
         "s_per_iter": round(s_per_iter, 3),
         "final_error": results[0]["final_error"],
-        "data_note": "synthetic Gaussian shards (zero-egress env); "
-                     "errors not comparable to real-data curves",
+        "data_note": (
+            "REAL data (bundled corpus, see data/datasets.py; shards may "
+            "reuse rows when nodes exceed the corpus shard capacity)"
+            if DATASETS[args.dataset].real else
+            "synthetic Gaussian shards (zero-egress env); "
+            "errors not comparable to real-data curves"),
         # per-phase wall-clock accounting (PhaseClock): node 0 plus the
         # node with the largest total, for diagnosing where round time goes
         "phases_node0": results[0].get("phases", {}),
