@@ -59,10 +59,13 @@ def krum_scores(deltas: jax.Array, num_adversaries: int) -> jax.Array:
 @partial(jax.jit, static_argnames=("num_adversaries",))
 def krum_accept_mask(deltas: jax.Array, num_adversaries: int) -> jax.Array:
     """Dense bool mask of the n − f accepted updates (lowest Krum scores;
-    ref: client_obj.py:119-124 argpartition)."""
+    ref: client_obj.py:119-124 argpartition). Large committees on TPU
+    score through the fused Pallas kernel (ops/krum_pallas)."""
+    from biscotti_tpu.ops.krum_pallas import krum_scores_auto
+
     n = deltas.shape[0]
     keep = n - num_adversaries
-    scores = krum_scores(deltas, num_adversaries)
+    scores = krum_scores_auto(deltas, num_adversaries)
     _, idx = jax.lax.top_k(-scores, keep)
     return jnp.zeros((n,), jnp.bool_).at[idx].set(True)
 
