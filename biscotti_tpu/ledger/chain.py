@@ -108,6 +108,14 @@ class Blockchain:
                 return True
         return False
 
+    def adoption_key(self) -> tuple:
+        """The fork-choice comparison key: (weight, length), weight =
+        non-empty block count. A chain is adopted over another iff its key
+        is strictly greater — the single source of truth shared by
+        maybe_adopt and the join path's chain-omission gate."""
+        return (sum(1 for b in self.blocks if not b.is_empty()),
+                len(self.blocks))
+
     def maybe_adopt(self, other: "Blockchain") -> bool:
         """Fork-choice adoption on (re)join (ref: main.go:1001-1013 adopts
         any longer chain blindly).
@@ -142,7 +150,7 @@ class Blockchain:
         def weight(blocks):
             return sum(1 for b in blocks if not b.is_empty())
 
-        mine_key = (weight(self.blocks), len(self.blocks))
+        mine_key = self.adoption_key()
         theirs_key = (weight(other.blocks), len(other.blocks))
         if theirs_key <= mine_key:
             return False

@@ -468,11 +468,27 @@ class PeerAgent:
 
     async def _h_register_peer(self, meta, arrays):
         """Join/announce: record the caller, return our chain so they can
-        adopt the longest one (ref: main.go:950-1024)."""
+        adopt the longest one (ref: main.go:950-1024 — which returns the
+        full chain unconditionally; at bootstrap that is N² chain bodies
+        on the wire, ~30 s of pure encode at N=150 single-box). The caller
+        states how many blocks it already holds and we reply with the
+        chain only when ours is strictly longer — peers at the same height
+        converge through block gossip and the advertise/pull catch-up, not
+        the join path."""
         pid = int(meta["source_id"])
         if "host" in meta and "port" in meta:
             self.peers[pid] = (meta["host"], int(meta["port"]))
         self.alive.add(pid)
+        # omit iff our chain would LOSE fork choice against the caller's
+        # claimed key — same (weight, length) rule as maybe_adopt, so an
+        # isolation survivor padded with empty blocks (long but light)
+        # still receives the heavier honest chain. Claims are advisory:
+        # overclaiming only denies the claimant a chain it would have
+        # refused to adopt anyway; the adopted chain itself is verified.
+        caller_key = (int(meta.get("have_weight", 0)),
+                      int(meta.get("have_blocks", 0)))
+        if self.chain.adoption_key() <= caller_key:
+            return {"chain_omitted": True}, {}
         cmeta, carrays = wire.pack_chain(self.chain.blocks)
         return cmeta, carrays
 
@@ -1462,6 +1478,14 @@ class PeerAgent:
                     # wire size for no reader (the delta is the receipt)
                     u.noise = None
                     u.noised_delta = None
+                    if cfg.fedsys:
+                        # the reference's FedSys broadcasts the MODEL only
+                        # (RegisterModel, FedSys/main.go:612-647) — there
+                        # is no ledger receipt of individual deltas. Keep
+                        # the contributor record, drop the array: a full
+                        # delta list made the block ~70x larger than the
+                        # model it carries
+                        u.delta = np.zeros(0, np.float64)
             deltas = updates
             contributors = [u.source_id for u in updates]
 
@@ -1596,14 +1620,26 @@ class PeerAgent:
         """Bootstrap: register with every peer concurrently, adopt the
         longest chain seen (ref: main.go:926-1024 — the reference announces
         serially; at N=100 a serial announce storm alone costs whole
-        rounds, so the fan-out runs as one gather)."""
+        rounds, so the fan-out runs as one gather).
+
+        Concurrency is bounded to the pool's connection cap: an unbounded
+        gather keeps every dialed connection busy at once, so LRU eviction
+        cannot close any of them and the CLUSTER transiently holds O(N²)
+        sockets — observed blowing the 20k fd limit at N≳150 single-box
+        (fedsys's star topology made it visible first, but the spike is
+        mode-independent). Bounded, the working set stays ≈ pool cap per
+        peer and eviction keeps up."""
+        sem = asyncio.Semaphore(self.pool.max_conns)
 
         async def one(pid: int) -> None:
             try:
-                cmeta, carrays = await self._call(
-                    pid, "RegisterPeer",
-                    {"source_id": self.id, "host": self.peers[self.id][0],
-                     "port": self.peers[self.id][1]})
+                async with sem:
+                    w, ln = self.chain.adoption_key()
+                    cmeta, carrays = await self._call(
+                        pid, "RegisterPeer",
+                        {"source_id": self.id, "host": self.peers[self.id][0],
+                         "port": self.peers[self.id][1],
+                         "have_weight": w, "have_blocks": ln})
                 blocks = wire.unpack_chain(cmeta, carrays)
                 if blocks and await asyncio.to_thread(
                         self._chain_quorums_ok, blocks):

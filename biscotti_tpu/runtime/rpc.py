@@ -239,7 +239,7 @@ class Pool:
 
         self._conns: "OrderedDict[Tuple[str, int], _Conn]" = OrderedDict()
         self._dialing: Dict[Tuple[str, int], asyncio.Task] = {}
-        self._max = max_conns
+        self.max_conns = max_conns
         # Optional per-link latency model (host, port) -> seconds, applied
         # to every call/post toward that link: the WAN/geo harness runs
         # loopback clusters with the reference's multi-region operating
@@ -256,7 +256,7 @@ class Pool:
         # hand its caller a closed conn)
         for k in [k for k, c in self._conns.items() if not c.alive]:
             self._conns.pop(k).close()
-        excess = len(self._conns) - self._max
+        excess = len(self._conns) - self.max_conns
         if excess <= 0:
             return
         for k in list(self._conns.keys()):

@@ -69,7 +69,7 @@ def build_cfgs(args):
     for i in range(args.nodes):
         cfgs.append(BiscottiConfig(
             node_id=i, num_nodes=args.nodes, dataset=args.dataset,
-            base_port=args.base_port,
+            model_name=args.model_name, base_port=args.base_port,
             num_miners=args.num_miners, num_verifiers=args.num_verifiers,
             num_noisers=args.num_noisers,
             secure_agg=bool(args.secure_agg), noising=bool(args.noising),
@@ -99,9 +99,24 @@ async def run_cluster(cfgs, log_dir="", key_dir="", geo_regions=0,
         for a in agents:
             a.pool.latency = geo_latency(a.id, a.cfg.base_port,
                                          geo_regions, n, geo_rtt_s)
+    stagger_s = 0.025
+
+    async def launch(i, a):
+        # stagger like the reference's shell launch loop (runBiscotti.sh
+        # starts processes one ssh at a time): N simultaneous announces
+        # hold O(N²) busy sockets cluster-wide before pool eviction can
+        # close any — single-box that transiently blew the 20k fd limit
+        # at N≳150
+        await asyncio.sleep(i * stagger_s)
+        return await a.run()
+
     t0 = time.time()
-    results = await asyncio.gather(*(a.run() for a in agents))
-    wall = time.time() - t0
+    results = await asyncio.gather(*(launch(i, a)
+                                     for i, a in enumerate(agents)))
+    # wall charges the protocol, not the harness: subtract the launch
+    # ramp (last agent starts (N-1)*stagger late; s_per_iter is computed
+    # from round-log timestamps and is unaffected either way)
+    wall = time.time() - t0 - (len(agents) - 1) * stagger_s
     return agents, results, wall
 
 
@@ -109,6 +124,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--dataset", default="creditcard")
+    ap.add_argument("--model", dest="model_name", default="",
+                    help="override the dataset's default model (zoo name, "
+                         "e.g. cifar_cnn / mnist_cnn / svm)")
     ap.add_argument("--base-port", type=int, default=26000)
     ap.add_argument("--iterations", type=int, default=3)
     ap.add_argument("--fedsys", action="store_true")
@@ -163,7 +181,8 @@ def main(argv=None) -> int:
     if key_dir == "auto":
         from biscotti_tpu.tools import keygen
 
-        key_dir = keygen.make_ephemeral_dir(args.dataset, args.nodes)
+        key_dir = keygen.make_ephemeral_dir(args.dataset, args.nodes,
+                                            args.model_name)
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
     agents, results, wall = asyncio.run(
@@ -191,6 +210,7 @@ def main(argv=None) -> int:
     mode = "fedsys" if args.fedsys else "biscotti"
     summary = {
         "mode": mode, "nodes": args.nodes, "dataset": args.dataset,
+        "model": args.model_name or "default",
         # all N peers share this host: s/iter here charges every peer's
         # compute+crypto to os.cpu_count() cores, where the reference's
         # fleet numbers (BASELINE.md) spread 100 nodes over ~20 multi-core

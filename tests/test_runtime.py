@@ -240,3 +240,54 @@ def test_cluster_cnn_model_secure_agg():
     lines = dumps[0].splitlines()
     assert len(lines) == 2
     assert "ndeltas=0" not in lines[1], dumps[0]
+
+
+def test_register_peer_chain_omission_gates_on_weight_not_length():
+    """The join reply omits the chain only when the responder would LOSE
+    fork choice: a partition survivor padded with empty timeout blocks
+    (long but LIGHT) must still be sent the heavier honest chain, or the
+    isolation re-announce heal path can never converge."""
+    import numpy as np
+
+    from biscotti_tpu.ledger import Block, BlockData, Blockchain
+
+    def chain_with(nonempty, empty, d=8, n=4):
+        c = Blockchain(num_params=d, num_nodes=n, default_stake=10)
+        for k in range(nonempty + empty):
+            deltas = []
+            if k < nonempty:
+                from biscotti_tpu.ledger import Update
+
+                deltas = [Update(source_id=0, iteration=c.next_iteration,
+                                 delta=np.ones(d))]
+            c.add_block(Block(
+                data=BlockData(iteration=c.next_iteration,
+                               global_w=c.latest_gradient(),
+                               deltas=deltas),
+                prev_hash=c.latest_hash(),
+                stake_map=c.latest_stake_map()).seal())
+        return c
+
+    async def go():
+        port = 24990
+        agent = PeerAgent(_cfg(0, 2, port))
+        agent.chain = chain_with(nonempty=5, empty=0)  # heavy: key (5, 6)
+
+        # survivor claims a LONGER but LIGHTER chain (1 real + 5 empties
+        # + genesis: weight 1, length 7) — must receive ours
+        meta, arrays = await agent._h_register_peer(
+            {"source_id": 1, "have_weight": 1, "have_blocks": 7}, {})
+        assert not meta.get("chain_omitted")
+        assert len(wire.unpack_chain(meta, arrays)) == 6
+
+        # caller already winning fork choice: omitted
+        meta, _ = await agent._h_register_peer(
+            {"source_id": 1, "have_weight": 5, "have_blocks": 7}, {})
+        assert meta.get("chain_omitted")
+
+        # legacy caller with no claim: always sent (back-compat)
+        meta, arrays = await agent._h_register_peer({"source_id": 1}, {})
+        assert not meta.get("chain_omitted")
+        return True
+
+    assert asyncio.run(go())
