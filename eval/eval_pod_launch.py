@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Fleet-launch eval — the multi-host launcher end to end, recorded.
+
+Drives tools/pod_launch.py over a two-"host" fleet where one host is
+`localhost` (direct subprocess launch) and the other is `127.0.0.1` —
+NOT the literal string localhost, so it takes the REMOTE branch: scp
+key/peers distribution, ssh launch, output collection (transport =
+tools/sshim.py, the local ssh/scp stand-in for zero-egress boxes; a real
+fleet swaps the flag back to ssh/scp). Mirrors the reference's Azure run
+driver (azure/azure-run/runBiscotti.sh: keygen, peersFileSent, scp to
+VMs, ssh-launch per VM, collect logs, diff chains).
+
+Artifact: eval/results/pod_launch.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes-per-host", type=int, default=4)
+    ap.add_argument("--dataset", default="creditcard")
+    ap.add_argument("--iterations", type=int, default=2)
+    ap.add_argument("--base-port", type=int, default=23560)
+    ap.add_argument("--out", default="eval/results")
+    args = ap.parse_args(argv)
+
+    from biscotti_tpu.tools import keygen
+
+    key_dir = keygen.make_ephemeral_dir(args.dataset,
+                                        2 * args.nodes_per_host)
+    hosts_file = tempfile.mktemp(prefix="biscotti_hosts_", suffix=".txt")
+    with open(hosts_file, "w") as f:
+        f.write("localhost\n127.0.0.1\n")
+
+    sshim = f"{sys.executable} -m biscotti_tpu.tools.sshim"
+    cmd = [sys.executable, "-m", "biscotti_tpu.tools.pod_launch",
+           "--hosts", hosts_file,
+           "--nodes-per-host", str(args.nodes_per_host),
+           "--dataset", args.dataset,
+           "--iterations", str(args.iterations),
+           "--base-port", str(args.base_port),
+           "--secure-agg", "1", "--noising", "1", "--verification", "1",
+           "--key-dir", key_dir,
+           "--peers-file", tempfile.mktemp(prefix="biscotti_peers_"),
+           "--ssh-cmd", sshim, "--scp-cmd", f"{sshim} --scp"]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    out = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                         cwd=REPO, env=env)
+    wall = time.time() - t0
+    summary = None
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            summary = json.loads(line)
+    if summary is None:
+        print(out.stdout[-500:], out.stderr[-500:], file=sys.stderr)
+        return 1
+
+    payload = {
+        "experiment": "pod_launch",
+        "transport": "sshim (local ssh/scp stand-in; real fleets use "
+                     "ssh/scp via the same flags)",
+        "hosts": 2, "remote_hosts": 1,
+        "nodes_per_host": args.nodes_per_host,
+        "dataset": args.dataset, "keyed": True,
+        "secure_agg": True, "noising": True, "verification": True,
+        "wall_s": round(wall, 2),
+        **summary,
+    }
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "pod_launch.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload))
+    return 0 if summary.get("chains_equal") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
