@@ -21,11 +21,13 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_cell(nodes, dataset, fedsys, iterations, base_port):
+def run_cell(nodes, dataset, fedsys, iterations, base_port, key_dir=""):
     cmd = [sys.executable, os.path.join(REPO, "eval", "scale_test.py"),
            "--nodes", str(nodes), "--dataset", dataset,
            "--iterations", str(iterations), "--verification", "1",
            "--base-port", str(base_port)]
+    if key_dir:
+        cmd += ["--key-dir", key_dir]
     if fedsys:
         cmd.append("--fedsys")
     out = subprocess.run(cmd, capture_output=True, text=True, timeout=900)
@@ -39,16 +41,27 @@ def run_cell(nodes, dataset, fedsys, iterations, base_port):
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--dataset", default="mnist")
-    ap.add_argument("--sizes", default="40,100")
+    ap.add_argument("--sizes", default="40,100,200")
     ap.add_argument("--iterations", type=int, default=3)
     ap.add_argument("--out", default="eval/results")
     args = ap.parse_args(argv)
 
+    # one dealer key dir for the largest size serves every cell (keys
+    # are per-node identities + a dims-sized commit key): the Biscotti
+    # cells pay the reference's full O(d) Pedersen plane, not the
+    # keyless SHA stand-in
+    sys.path.insert(0, REPO)
+    from biscotti_tpu.tools import keygen
+
+    sizes = [int(s) for s in args.sizes.split(",")]
+    key_dir = keygen.make_ephemeral_dir(args.dataset, max(sizes))
+
     rows = []
     port = 27000
-    for n in (int(s) for s in args.sizes.split(",")):
+    for n in sizes:
         for fedsys in (False, True):
-            cell = run_cell(n, args.dataset, fedsys, args.iterations, port)
+            cell = run_cell(n, args.dataset, fedsys, args.iterations, port,
+                            key_dir)
             port += n + 10
             row = {"nodes": n, "mode": cell["mode"],
                    "s_per_iter": cell["s_per_iter"],
@@ -65,7 +78,8 @@ def main(argv=None) -> int:
                     f"{r['final_error']}\n")
     with open(os.path.join(args.out, "fedsys_compare.json"), "w") as f:
         json.dump({"experiment": "fedsys_compare", "dataset": args.dataset,
-                   "iterations": args.iterations, "rows": rows,
+                   "iterations": args.iterations, "keyed": True,
+                   "rows": rows,
                    "host_note": "all peers share one host; see scale_test",
                    "reference": {"biscotti_100": "38.2-42.0 s/iter",
                                  "fedsys_100": "7.1-9.1 s/iter"}},

@@ -84,7 +84,7 @@ def build_cfgs(args):
 
 
 async def run_cluster(cfgs, log_dir="", key_dir="", geo_regions=0,
-                      geo_rtt_s=0.0):
+                      geo_rtt_s=0.0, pool_conns=0):
     from biscotti_tpu.runtime.peer import PeerAgent
     from biscotti_tpu.runtime.rpc import geo_latency
 
@@ -94,6 +94,11 @@ async def run_cluster(cfgs, log_dir="", key_dir="", geo_regions=0,
                   if log_dir else "")
         for c in cfgs
     ]
+    if pool_conns:
+        # single-box fd budget: every loopback conn costs 2 fds in-process
+        # (~ 2*N*cap total), so very large N needs a smaller per-peer pool
+        for a in agents:
+            a.pool.max_conns = pool_conns
     if geo_regions > 1:
         n = len(cfgs)
         for a in agents:
@@ -141,6 +146,10 @@ def main(argv=None) -> int:
     ap.add_argument("--num-verifiers", type=int, default=3)
     ap.add_argument("--num-noisers", type=int, default=2)
     ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--pool-conns", type=int, default=0,
+                    help="override each peer's connection-pool cap "
+                         "(0 = library default); N>=300 single-box needs "
+                         "a smaller pool to fit the 20k fd budget")
     ap.add_argument("--share-redundancy", default=None,
                     help="a float overrides the config default (1.5 "
                          "hardened); 'auto' keeps the default where its "
@@ -188,7 +197,8 @@ def main(argv=None) -> int:
     agents, results, wall = asyncio.run(
         run_cluster(cfgs, args.log_dir, key_dir,
                     geo_regions=args.geo_regions,
-                    geo_rtt_s=args.geo_rtt_ms / 1000.0))
+                    geo_rtt_s=args.geo_rtt_ms / 1000.0,
+                    pool_conns=args.pool_conns))
 
     dumps = [r["chain_dump"] for r in results]
     equal = all(d == dumps[0] for d in dumps)
