@@ -16,11 +16,14 @@
 // on the ledger; no secret scalars pass through this code path).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <memory>
 #include <mutex>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -150,12 +153,13 @@ fe fe_invert(const fe &a) {
   return r;
 }
 
-// canonical reduction and serialization
-void fe_tobytes(uint8_t out[32], const fe &a) {
+// Freeze to the canonical representative in [0, p), limbs < 2^51 — the one
+// shared reduction both serialization and fast equality run on.
+inline void fe_canon(const fe &a, uint64_t l[5]) {
   fe t = a;
   fe_carry(t);
   fe_carry(t);  // second pass fully normalizes every limb below 2^51
-  uint64_t l[5] = {t.v[0], t.v[1], t.v[2], t.v[3], t.v[4]};
+  l[0] = t.v[0]; l[1] = t.v[1]; l[2] = t.v[2]; l[3] = t.v[3]; l[4] = t.v[4];
   // freeze: value < 2p here, so at most one conditional subtract of
   // p = {2^51-19, 2^51-1, 2^51-1, 2^51-1, 2^51-1}
   bool ge = (l[4] == MASK51 && l[3] == MASK51 && l[2] == MASK51 &&
@@ -164,6 +168,22 @@ void fe_tobytes(uint8_t out[32], const fe &a) {
     l[0] -= (MASK51 - 18);
     l[1] = 0; l[2] = 0; l[3] = 0; l[4] = 0;
   }
+}
+
+// Equality mod p on canonical limbs — no byte packing (the hot validator
+// calls this per point; fe_tobytes' 128-bit packing loop was ~2× the cost)
+inline bool fe_eq_fast(const fe &a, const fe &b) {
+  uint64_t la[5], lb[5];
+  fe_canon(a, la);
+  fe_canon(b, lb);
+  return ((la[0] ^ lb[0]) | (la[1] ^ lb[1]) | (la[2] ^ lb[2]) |
+          (la[3] ^ lb[3]) | (la[4] ^ lb[4])) == 0;
+}
+
+// canonical reduction and serialization
+void fe_tobytes(uint8_t out[32], const fe &a) {
+  uint64_t l[5];
+  fe_canon(a, l);
   // pack 5×51 -> 32 bytes LE
   uint8_t o[32];
   memset(o, 0, 32);
@@ -326,12 +346,7 @@ fe fe_pow(const fe &a, const uint8_t e[32]) {
   return r;
 }
 
-inline bool fe_eq(const fe &a, const fe &b) {
-  uint8_t ab[32], bb[32];
-  fe_tobytes(ab, a);
-  fe_tobytes(bb, b);
-  return memcmp(ab, bb, 32) == 0;
-}
+inline bool fe_eq(const fe &a, const fe &b) { return fe_eq_fast(a, b); }
 
 inline bool fe_is_zero(const fe &a) {
   uint8_t ab[32];
@@ -360,6 +375,48 @@ struct Consts {
 const Consts &consts() {
   static Consts c;
   return c;
+}
+
+// ------------------------------------------------------------- threading
+//
+// Fork-join slices over an index range. Thread count comes from
+// BISCOTTI_NATIVE_THREADS (default: hardware_concurrency) and is further
+// capped so every thread gets at least `min_per_thread` items — small
+// inputs never pay thread spawn latency. T == 1 runs inline on the caller
+// with zero overhead, so single-core hosts see the exact pre-threading
+// code path. Join-based with no shared mutable state beyond what each
+// call site hands its slices (TSAN-clean by construction; `make tsan`).
+int native_threads() {
+  // magic static: first concurrent callers race-free per C++11 (the
+  // library is called from concurrent to_thread workers)
+  static const int t = [] {
+    const char *e = getenv("BISCOTTI_NATIVE_THREADS");
+    int v = e ? atoi(e) : (int)std::thread::hardware_concurrency();
+    if (v < 1) v = 1;
+    if (v > 64) v = 64;
+    return v;
+  }();
+  return t;
+}
+
+void parallel_slices(size_t n, size_t min_per_thread,
+                     const std::function<void(size_t, size_t)> &fn) {
+  size_t T = (size_t)native_threads();
+  if (min_per_thread == 0) min_per_thread = 1;
+  if (T > n / min_per_thread) T = n / min_per_thread;
+  if (T <= 1) {
+    fn(0, n);
+    return;
+  }
+  size_t per = (n + T - 1) / T;
+  std::vector<std::thread> ths;
+  ths.reserve(T);
+  for (size_t i = 0; i < T; i++) {
+    size_t lo = i * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    ths.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto &th : ths) th.join();
 }
 
 }  // namespace
@@ -450,78 +507,93 @@ int msm_core(const uint8_t *scalars, const uint8_t *signs,
   // from the next window (digit − 2^C), so every digit lands in
   // [−2^(C-1)+1, 2^(C-1)]. A trailing carry lands in the extra top window.
   std::vector<int32_t> digits((size_t)nwin * n);
-  for (size_t i = 0; i < n; i++) {
-    const uint8_t *s = scalars + i * 32;
-    int neg = signs && signs[i];
-    int32_t carry = 0;
-    for (int w = 0; w < nwin; w++) {
-      int pos = w * C;
-      int32_t d =
-          (pos <= maxbit ? (int32_t)scalar_bits(s, pos, C) : 0) + carry;
-      if (d > half) {
-        d -= 1 << C;
-        carry = 1;
-      } else {
-        carry = 0;
+  parallel_slices(n, 8192, [&](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; i++) {
+      const uint8_t *s = scalars + i * 32;
+      int neg = signs && signs[i];
+      int32_t carry = 0;
+      for (int w = 0; w < nwin; w++) {
+        int pos = w * C;
+        int32_t d =
+            (pos <= maxbit ? (int32_t)scalar_bits(s, pos, C) : 0) + carry;
+        if (d > half) {
+          d -= 1 << C;
+          carry = 1;
+        } else {
+          carry = 0;
+        }
+        digits[(size_t)w * n + i] = neg ? -d : d;
       }
-      digits[(size_t)w * n + i] = neg ? -d : d;
     }
-  }
+  });
 
-  std::vector<ge> buckets(half);
-  std::vector<bool> used(half);
+  // Window sums are independent — threads each take a contiguous range of
+  // windows (own bucket table, ~half·160 B, reused across its windows);
+  // the serial tail combines them under the doubling ladder. T == 1
+  // reproduces the classic high→low single-bucket-table sweep exactly.
+  std::vector<ge> wsum(nwin, ge_identity());
+  std::vector<uint8_t> wset(nwin, 0);
+  parallel_slices((size_t)nwin, 1, [&](size_t wlo, size_t whi) {
+    std::vector<ge> buckets(half);
+    std::vector<bool> used(half);
+    for (size_t w = wlo; w < whi; w++) {
+      std::fill(used.begin(), used.end(), false);
+      const int32_t *dw = digits.data() + w * n;
+      for (size_t i = 0; i < n; i++) {
+        // the bucket index 8 iterations ahead is already in the digits
+        // array — prefetch its cache lines so the random bucket-table
+        // access doesn't stall the madd chain (the table exceeds L2 at
+        // the large-n window widths this workload picks)
+        if (i + 8 < n) {
+          int32_t dn = dw[i + 8];
+          if (dn) {
+            const ge *bp = &buckets[(dn > 0 ? dn : -dn) - 1];
+            __builtin_prefetch(bp, 1);
+            __builtin_prefetch(reinterpret_cast<const char *>(bp) + 64, 1);
+            __builtin_prefetch(reinterpret_cast<const char *>(bp) + 128,
+                               1);
+          }
+          __builtin_prefetch(&npts[i + 4]);
+        }
+        int32_t d = dw[i];
+        if (d > 0) {
+          int b = d - 1;
+          buckets[b] = used[b] ? ge_madd(buckets[b], npts[i])
+                               : ge_madd(ge_identity(), npts[i]);
+          used[b] = true;
+        } else if (d < 0) {
+          int b = -d - 1;
+          buckets[b] = used[b] ? ge_msub(buckets[b], npts[i])
+                               : ge_msub(ge_identity(), npts[i]);
+          used[b] = true;
+        }
+      }
+      ge running = ge_identity();
+      bool running_set = false;
+      ge window_sum = ge_identity();
+      bool window_set = false;
+      for (int b = half - 1; b >= 0; b--) {
+        if (used[b]) {
+          running = running_set ? ge_add(running, buckets[b]) : buckets[b];
+          running_set = true;
+        }
+        if (running_set) {
+          window_sum = window_set ? ge_add(window_sum, running) : running;
+          window_set = true;
+        }
+      }
+      wsum[w] = window_sum;
+      wset[w] = window_set ? 1 : 0;
+    }
+  });
+
   ge acc = ge_identity();
   bool acc_set = false;
-
   for (int w = nwin - 1; w >= 0; w--) {
     if (acc_set)
       for (int k = 0; k < C; k++) acc = ge_double(acc);
-    std::fill(used.begin(), used.end(), false);
-    const int32_t *dw = digits.data() + (size_t)w * n;
-    for (size_t i = 0; i < n; i++) {
-      // the bucket index 8 iterations ahead is already in the digits
-      // array — prefetch its cache lines so the random bucket-table
-      // access doesn't stall the madd chain (the table exceeds L2 at the
-      // large-n window widths this workload picks)
-      if (i + 8 < n) {
-        int32_t dn = dw[i + 8];
-        if (dn) {
-          const ge *bp = &buckets[(dn > 0 ? dn : -dn) - 1];
-          __builtin_prefetch(bp, 1);
-          __builtin_prefetch(reinterpret_cast<const char *>(bp) + 64, 1);
-          __builtin_prefetch(reinterpret_cast<const char *>(bp) + 128, 1);
-        }
-        __builtin_prefetch(&npts[i + 4]);
-      }
-      int32_t d = dw[i];
-      if (d > 0) {
-        int b = d - 1;
-        buckets[b] = used[b] ? ge_madd(buckets[b], npts[i])
-                             : ge_madd(ge_identity(), npts[i]);
-        used[b] = true;
-      } else if (d < 0) {
-        int b = -d - 1;
-        buckets[b] = used[b] ? ge_msub(buckets[b], npts[i])
-                             : ge_msub(ge_identity(), npts[i]);
-        used[b] = true;
-      }
-    }
-    ge running = ge_identity();
-    bool running_set = false;
-    ge window_sum = ge_identity();
-    bool window_set = false;
-    for (int b = half - 1; b >= 0; b--) {
-      if (used[b]) {
-        running = running_set ? ge_add(running, buckets[b]) : buckets[b];
-        running_set = true;
-      }
-      if (running_set) {
-        window_sum = window_set ? ge_add(window_sum, running) : running;
-        window_set = true;
-      }
-    }
-    if (window_set) {
-      acc = acc_set ? ge_add(acc, window_sum) : window_sum;
+    if (wset[w]) {
+      acc = acc_set ? ge_add(acc, wsum[w]) : wsum[w];
       acc_set = true;
     }
   }
@@ -569,26 +641,27 @@ int ed25519_scalarmult(const uint8_t *scalar, const uint8_t *point,
 // x, y and the t = x·y product (already needed by the curve equation,
 // reused by callers for extended/niels forms).
 static bool load_affine_checked(const uint8_t *xb, fe &x, fe &y, fe &t) {
-  static const uint8_t pbytes[32] = {
-      0xED, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
-      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF,
-      0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0xFF, 0x7F};
+  // canonical (< p) via four u64 words — branch-light, no byte loop
   auto canonical = [](const uint8_t *b) {
-    for (int i = 31; i >= 0; i--) {
-      if (b[i] < pbytes[i]) return true;
-      if (b[i] > pbytes[i]) return false;
-    }
-    return false;  // == p
+    uint64_t w0, w1, w2, w3;
+    memcpy(&w0, b, 8);
+    memcpy(&w1, b + 8, 8);
+    memcpy(&w2, b + 16, 8);
+    memcpy(&w3, b + 24, 8);
+    if (w3 != 0x7FFFFFFFFFFFFFFFULL) return w3 < 0x7FFFFFFFFFFFFFFFULL;
+    if ((w2 & w1) != ~0ULL) return true;
+    return w0 < 0xFFFFFFFFFFFFFFEDULL;
   };
   const uint8_t *yb = xb + 32;
   if (!canonical(xb) || !canonical(yb)) return false;
   x = fe_frombytes(xb);
   y = fe_frombytes(yb);
   t = fe_mul(x, y);
-  // -x^2 + y^2 == 1 + d*(x*y)^2
+  // -x^2 + y^2 == 1 + d*(x*y)^2  (carried operands keep fe_canon's
+  // value-below-2p freeze precondition airtight)
   fe lhs = fe_sub(fe_sq(y), fe_sq(x));
   fe rhs = fe_add(fe_one(), fe_mul(consts().d, fe_sq(t)));
-  return fe_eq(lhs, rhs);
+  return fe_eq_fast(lhs, rhs);
 }
 
 // Batch affine-coordinate loader: n×64-byte (x,y) little-endian pairs →
@@ -620,29 +693,47 @@ int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
 int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
                         uint8_t *out) {
   if (n_batches == 0 || n == 0) return 1;
-  std::vector<ge> acc(n);
-  // batch-major sweep: each pass reads one batch sequentially (cache-
-  // friendly at C·k ≈ 62k points × 64B) and folds it into the running sum
-  for (size_t b = 0; b < n_batches; b++) {
-    for (size_t i = 0; i < n; i++) {
-      fe x, y, t;
-      if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t))
-        return (int)(b * n + i + 1);
-      if (b == 0) {
-        acc[i] = ge{x, y, fe_one(), t};
-      } else {
-        nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
-        acc[i] = ge_madd(acc[i], q);
+  // threaded over the point index: each slice owns acc[lo,hi) and sweeps
+  // it batch-major (each pass reads one batch's slice sequentially —
+  // cache-friendly at C·k ≈ 62k points × 64B). On a failed point the
+  // slice records its first bad flat index and stops; the reported index
+  // is the minimum across slices (callers treat any nonzero rc as
+  // "reject the whole batch set", so exact batch-major order of the
+  // reported index does not matter — biscotti_tpu/crypto/_native.py
+  // load_xy_sum discards it).
+  std::atomic<size_t> first_bad{SIZE_MAX};
+  parallel_slices(n, 2048, [&](size_t lo, size_t hi) {
+    std::vector<ge> acc(hi - lo);
+    for (size_t b = 0; b < n_batches; b++) {
+      if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
+      for (size_t i = lo; i < hi; i++) {
+        fe x, y, t;
+        if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t)) {
+          size_t idx = b * n + i;
+          size_t cur = first_bad.load(std::memory_order_relaxed);
+          while (idx < cur &&
+                 !first_bad.compare_exchange_weak(cur, idx)) {
+          }
+          return;
+        }
+        if (b == 0) {
+          acc[i - lo] = ge{x, y, fe_one(), t};
+        } else {
+          nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
+          acc[i - lo] = ge_madd(acc[i - lo], q);
+        }
       }
     }
-  }
-  for (size_t i = 0; i < n; i++) {
-    uint8_t *o = out + i * 128;
-    fe_tobytes(o, acc[i].X);
-    fe_tobytes(o + 32, acc[i].Y);
-    fe_tobytes(o + 64, acc[i].Z);
-    fe_tobytes(o + 96, acc[i].T);
-  }
+    for (size_t i = lo; i < hi; i++) {
+      uint8_t *o = out + i * 128;
+      fe_tobytes(o, acc[i - lo].X);
+      fe_tobytes(o + 32, acc[i - lo].Y);
+      fe_tobytes(o + 64, acc[i - lo].Z);
+      fe_tobytes(o + 96, acc[i - lo].T);
+    }
+  });
+  size_t bad = first_bad.load();
+  if (bad != SIZE_MAX) return (int)(bad + 1);
   return 0;
 }
 
@@ -730,20 +821,25 @@ int ed25519_vss_rlc_scalars(const int64_t *xs, const uint64_t *gammas,
                             uint8_t *out_scalars, uint8_t *out_signs) {
   typedef __int128 i128;
   std::vector<i128> acc_lo(C * k, 0), acc_hi(C * k, 0);
-  for (size_t r = 0; r < S; r++) {
-    int64_t x = xs[r];
-    for (size_t c = 0; c < C; c++) {
-      uint64_t g_lo = gammas[2 * (r * C + c)];
-      uint64_t g_hi = gammas[2 * (r * C + c) + 1];
-      i128 xj = 1;
+  // chunk-major and threaded over chunks: coefficient columns c·k..c·k+k−1
+  // receive contributions only from their own chunk's (row, γ) cells, so
+  // slices share nothing
+  parallel_slices(C, 256, [&](size_t clo, size_t chi) {
+    for (size_t c = clo; c < chi; c++) {
       size_t base = c * k;
-      for (size_t j = 0; j < k; j++) {
-        acc_lo[base + j] += (i128)g_lo * xj;
-        acc_hi[base + j] += (i128)g_hi * xj;
-        xj *= x;
+      for (size_t r = 0; r < S; r++) {
+        int64_t x = xs[r];
+        uint64_t g_lo = gammas[2 * (r * C + c)];
+        uint64_t g_hi = gammas[2 * (r * C + c) + 1];
+        i128 xj = 1;
+        for (size_t j = 0; j < k; j++) {
+          acc_lo[base + j] += (i128)g_lo * xj;
+          acc_hi[base + j] += (i128)g_hi * xj;
+          xj *= x;
+        }
       }
     }
-  }
+  });
   for (size_t i = 0; i < C * k; i++) {
     // v = 8·(acc_hi·2^64 + acc_lo), |acc_*| ≤ 2^113 so 8·acc fits i128.
     // Decompose v = upper·2^64 + low64 with 0 ≤ low64 < 2^64 using
@@ -843,11 +939,20 @@ int ed25519_vss_blind_rows(const uint8_t *blinds, const int64_t *xs,
     }
   };
   for (size_t s = 0; s < S; s++) {
+    uint64_t xa = xs[s] < 0 ? (uint64_t)(-(long long)xs[s])
+                            : (uint64_t)xs[s];
+    if (xa >> 31) return -1;  // share points are tiny by construction
+  }
+  // threaded over flattened (share point, chunk) cells — each cell's
+  // Horner chain is independent
+  parallel_slices(S * C, 4096, [&](size_t lo, size_t hi) {
+  for (size_t cell = lo; cell < hi; cell++) {
+    size_t s = cell / C;
     int64_t x = xs[s];
     uint64_t xa = x < 0 ? (uint64_t)(-(long long)x) : (uint64_t)x;
-    if (xa >> 31) return -1;  // share points are tiny by construction
     bool xneg = x < 0;
-    for (size_t c = 0; c < C; c++) {
+    {
+      size_t c = cell % C;
       uint64_t acc[4] = {0, 0, 0, 0};
       for (size_t j = k; j-- > 0;) {
         // acc ← acc·x mod q  (skip when acc is zero)
@@ -915,6 +1020,7 @@ int ed25519_vss_blind_rows(const uint8_t *blinds, const int64_t *xs,
       memcpy(out + 32 * (s * C + c), acc, 32);
     }
   }
+  });
   return 0;
 }
 
@@ -936,38 +1042,67 @@ int ed25519_vss_st_accum(const uint64_t *gammas, const int64_t *rows,
   uint64_t s_acc[5] = {0, 0, 0, 0, 0};
   uint64_t t_acc[7] = {0, 0, 0, 0, 0, 0, 0};
   size_t cells = S * C;
-  for (size_t i = 0; i < cells; i++) {
-    uint64_t g[2] = {gammas[2 * i], gammas[2 * i + 1]};
-    // s: γ · row (signed)
-    int64_t r = rows[i];
-    uint64_t m = r < 0 ? (uint64_t)(-(unsigned long long)r) : (uint64_t)r;
-    for (int gl = 0; gl < 2; gl++) {
-      unsigned __int128 p = (unsigned __int128)g[gl] * m;
-      if (r < 0) {
-        acc_sub_at(s_acc, 5, gl, (uint64_t)p);
-        acc_sub_at(s_acc, 5, gl + 1, (uint64_t)(p >> 64));
-      } else {
-        acc_add_at(s_acc, 5, gl, (uint64_t)p);
-        acc_add_at(s_acc, 5, gl + 1, (uint64_t)(p >> 64));
+  // threaded over cells with per-slice accumulators; merging is plain
+  // multi-limb addition (two's-complement wrap on the fixed width — sums
+  // of per-slice partials equal the serial total exactly)
+  std::mutex merge_mu;
+  std::atomic<size_t> first_bad{SIZE_MAX};
+  parallel_slices(cells, 65536, [&](size_t lo, size_t hi) {
+    uint64_t sl_s[5] = {0, 0, 0, 0, 0};
+    uint64_t sl_t[7] = {0, 0, 0, 0, 0, 0, 0};
+    for (size_t i = lo; i < hi; i++) {
+      uint64_t g[2] = {gammas[2 * i], gammas[2 * i + 1]};
+      // s: γ · row (signed)
+      int64_t r = rows[i];
+      uint64_t m = r < 0 ? (uint64_t)(-(unsigned long long)r) : (uint64_t)r;
+      for (int gl = 0; gl < 2; gl++) {
+        unsigned __int128 p = (unsigned __int128)g[gl] * m;
+        if (r < 0) {
+          acc_sub_at(sl_s, 5, gl, (uint64_t)p);
+          acc_sub_at(sl_s, 5, gl + 1, (uint64_t)(p >> 64));
+        } else {
+          acc_add_at(sl_s, 5, gl, (uint64_t)p);
+          acc_add_at(sl_s, 5, gl + 1, (uint64_t)(p >> 64));
+        }
+      }
+      // t: γ · t_val (both non-negative); t_val must be canonical (< q)
+      uint64_t t[4];
+      memcpy(t, blinds + 32 * i, 32);
+      bool lt = false, gt = false;
+      for (int l = 3; l >= 0 && !lt && !gt; l--) {
+        if (t[l] < Q[l]) lt = true;
+        else if (t[l] > Q[l]) gt = true;
+      }
+      if (!lt) {  // t_val ≥ q: non-canonical, refuse
+        size_t cur = first_bad.load(std::memory_order_relaxed);
+        while (i < cur && !first_bad.compare_exchange_weak(cur, i)) {
+        }
+        return;
+      }
+      for (int gl = 0; gl < 2; gl++) {
+        for (int tl = 0; tl < 4; tl++) {
+          unsigned __int128 p = (unsigned __int128)g[gl] * t[tl];
+          acc_add_at(sl_t, 7, gl + tl, (uint64_t)p);
+          acc_add_at(sl_t, 7, gl + tl + 1, (uint64_t)(p >> 64));
+        }
       }
     }
-    // t: γ · t_val (both non-negative); t_val must be canonical (< q)
-    uint64_t t[4];
-    memcpy(t, blinds + 32 * i, 32);
-    bool lt = false, gt = false;
-    for (int l = 3; l >= 0 && !lt && !gt; l--) {
-      if (t[l] < Q[l]) lt = true;
-      else if (t[l] > Q[l]) gt = true;
+    std::lock_guard<std::mutex> lk(merge_mu);
+    uint64_t c = 0;
+    for (int l = 0; l < 5; l++) {
+      unsigned __int128 v = (unsigned __int128)s_acc[l] + sl_s[l] + c;
+      s_acc[l] = (uint64_t)v;
+      c = (uint64_t)(v >> 64);
     }
-    if (!lt) return (int)(i + 1);  // t_val ≥ q: non-canonical, refuse
-    for (int gl = 0; gl < 2; gl++) {
-      for (int tl = 0; tl < 4; tl++) {
-        unsigned __int128 p = (unsigned __int128)g[gl] * t[tl];
-        acc_add_at(t_acc, 7, gl + tl, (uint64_t)p);
-        acc_add_at(t_acc, 7, gl + tl + 1, (uint64_t)(p >> 64));
-      }
+    c = 0;
+    for (int l = 0; l < 7; l++) {
+      unsigned __int128 v = (unsigned __int128)t_acc[l] + sl_t[l] + c;
+      t_acc[l] = (uint64_t)v;
+      c = (uint64_t)(v >> 64);
     }
-  }
+  });
+  size_t bad = first_bad.load();
+  if (bad != SIZE_MAX) return (int)(bad + 1);
   memcpy(out_s, s_acc, 40);
   memcpy(out_t, t_acc, 56);
   return 0;
@@ -1060,57 +1195,72 @@ int batch_commit_core(const uint8_t *a_scalars, const uint8_t *a_signs,
   const nge *comb_g = tg->entries.data();
   const nge *comb_h = th ? th->entries.data() : nullptr;
 
-  std::vector<ge> res(n);
-  for (size_t i = 0; i < n; i++) {
-    // prefetch the NEXT commitment's table entries a whole commitment
-    // (~5 µs of madds) ahead — every H16 read is a fresh line in a 126 MB
-    // table, so one-window-ahead prefetching hid too little latency.
-    // (The ~1 MB byte comb lives in cache; prefetching buys nothing.)
-    if (!h_byte && comb_h && i + 1 < n) {
-      const uint8_t *bn = b_scalars + (i + 1) * 32;
-      for (int j = 0; j < 16; j++) {
-        uint32_t vn = (uint32_t)bn[2 * j] | ((uint32_t)bn[2 * j + 1] << 8);
-        if (vn) {
-          const nge *np_ = &comb_h[(size_t)j * 65536 + vn];
-          __builtin_prefetch(np_);
-          __builtin_prefetch(reinterpret_cast<const char *>(np_) + 64);
+  // Threaded over commitments; within a slice, LANES commitments advance
+  // together through the window sweep: their table lookups are independent
+  // dependency chains, so the out-of-order core overlaps the H16 table's
+  // LLC misses (one chain alone serializes madd → miss → madd at ~230 ns
+  // per window; four chains keep ~4 misses in flight).
+  constexpr size_t LANES = 4;
+  parallel_slices(n, 512, [&](size_t lo, size_t hi) {
+    std::vector<ge> res(hi - lo);
+    for (size_t i0 = lo; i0 < hi; i0 += LANES) {
+      const size_t m = std::min(LANES, hi - i0);
+      // prefetch the NEXT group's H16 entries a whole group (~20 µs of
+      // madds) ahead — every H16 read is a fresh line in a 126 MB table.
+      // (The ~1 MB byte comb lives in cache; prefetching buys nothing.)
+      if (!h_byte && comb_h && i0 + LANES < hi) {
+        for (size_t l = 0; l < std::min(LANES, hi - i0 - LANES); l++) {
+          const uint8_t *bn = b_scalars + (i0 + LANES + l) * 32;
+          for (int j = 0; j < 16; j++) {
+            uint32_t vn =
+                (uint32_t)bn[2 * j] | ((uint32_t)bn[2 * j + 1] << 8);
+            if (vn) {
+              const nge *np_ = &comb_h[(size_t)j * 65536 + vn];
+              __builtin_prefetch(np_);
+              __builtin_prefetch(reinterpret_cast<const char *>(np_) + 64);
+              __builtin_prefetch(reinterpret_cast<const char *>(np_) + 128);
+            }
+          }
         }
       }
-    }
-    ge acc = ge_identity();
-    const uint8_t *b = b_scalars + i * 32;
-    if (h_byte && comb_h) {
-      for (int j = 0; j < 32; j++) {
-        uint8_t v = b[j];
-        if (v) acc = ge_madd(acc, comb_h[(size_t)j * 256 + v]);
+      ge acc[LANES];
+      for (size_t l = 0; l < m; l++) acc[l] = ge_identity();
+      if (h_byte && comb_h) {
+        for (int j = 0; j < 32; j++)
+          for (size_t l = 0; l < m; l++) {
+            uint8_t v = b_scalars[(i0 + l) * 32 + j];
+            if (v) acc[l] = ge_madd(acc[l], comb_h[(size_t)j * 256 + v]);
+          }
+      } else if (comb_h) {
+        for (int j = 0; j < 16; j++)
+          for (size_t l = 0; l < m; l++) {
+            const uint8_t *b = b_scalars + (i0 + l) * 32;
+            uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
+            if (v) acc[l] = ge_madd(acc[l], comb_h[(size_t)j * 65536 + v]);
+          }
       }
-    } else if (comb_h) {
-      for (int j = 0; j < 16; j++) {
-        uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
-        if (v) acc = ge_madd(acc, comb_h[(size_t)j * 65536 + v]);
-      }
+      for (int j = 0; j < 32; j++)
+        for (size_t l = 0; l < m; l++) {
+          uint8_t av = a_scalars[(i0 + l) * 32 + j];
+          if (av) {
+            const nge &e = comb_g[j * 256 + av];
+            acc[l] = (a_signs && a_signs[i0 + l]) ? ge_msub(acc[l], e)
+                                                  : ge_madd(acc[l], e);
+          }
+        }
+      for (size_t l = 0; l < m; l++) res[i0 + l - lo] = acc[l];
     }
-    const uint8_t *a = a_scalars + i * 32;
-    bool neg = a_signs && a_signs[i];
-    for (int j = 0; j < 32; j++) {
-      uint8_t av = a[j];
-      if (av) {
-        const nge &e = comb_g[j * 256 + av];
-        acc = neg ? ge_msub(acc, e) : ge_madd(acc, e);
-      }
-    }
-    res[i] = acc;
-  }
 
-  // serialize affine with one shared batch inversion
-  std::vector<fe> zinv;
-  ge_batch_zinv(res, zinv);
-  for (size_t i = 0; i < n; i++) {
-    fe x = fe_mul(res[i].X, zinv[i]);
-    fe y = fe_mul(res[i].Y, zinv[i]);
-    fe_tobytes(out + i * 64, x);
-    fe_tobytes(out + i * 64 + 32, y);
-  }
+    // serialize affine with one batch inversion per slice
+    std::vector<fe> zinv;
+    ge_batch_zinv(res, zinv);
+    for (size_t i = lo; i < hi; i++) {
+      fe x = fe_mul(res[i - lo].X, zinv[i - lo]);
+      fe y = fe_mul(res[i - lo].Y, zinv[i - lo]);
+      fe_tobytes(out + i * 64, x);
+      fe_tobytes(out + i * 64 + 32, y);
+    }
+  });
   return 0;
 }
 
