@@ -108,6 +108,19 @@ class BatchStepper:
         self._served: Dict[int, int] = {}
         self.batches = 0  # sharded dispatch count (observability/tests)
 
+        # shared convergence metric: every peer scores the SAME model on the
+        # SAME global test split each round (peer.py's uniform-convergence
+        # requirement), so one evaluation serves the whole cluster. Keyed on
+        # (iteration, weight digest) — transiently divergent chains compute
+        # their own value, identical chains share one.
+        test = ds.load_shard(cfg.dataset, f"{cfg.dataset}_test")
+        self._x_test = jnp.asarray(test["x_test"])
+        self._y_test = jnp.asarray(test["y_test"])
+        self._err_fn = jax.jit(model.error_flat)
+        self._eval_cache: Dict[tuple, float] = {}
+        self._eval_pending: Dict[tuple, asyncio.Future] = {}
+        self.evals = 0  # distinct metric computations (observability/tests)
+
     async def step(self, peer_id: int, w: np.ndarray, it: int) -> np.ndarray:
         """This peer's delta for iteration `it`; the first caller computes
         the whole batch on the mesh."""
@@ -144,6 +157,44 @@ class BatchStepper:
         for old in [k for k in self._cache if k < it - 3]:
             self._cache.pop(old, None)
         return delta
+
+    async def test_error(self, w: np.ndarray, it: int) -> float:
+        """Global-test-split error of `w` — computed once per distinct
+        (iteration, weights) across the cluster; all other peers are served
+        from the memo (they evaluate identical inputs, see __init__)."""
+        import hashlib
+
+        import jax.numpy as jnp
+
+        wb = np.ascontiguousarray(w)
+        key = (it, hashlib.sha1(wb.tobytes()).hexdigest())
+        if key not in self._eval_cache:
+            if key in self._eval_pending:
+                await self._eval_pending[key]
+            else:
+                fut = asyncio.get_running_loop().create_future()
+                self._eval_pending[key] = fut
+                try:
+                    err = await asyncio.to_thread(
+                        lambda: float(self._err_fn(
+                            jnp.asarray(wb, jnp.float32),
+                            self._x_test, self._y_test)))
+                except BaseException as e:
+                    fut.set_exception(e)
+                    fut.exception()  # mark retrieved if nobody is waiting
+                    del self._eval_pending[key]
+                    raise
+                self._eval_cache[key] = err
+                self.evals += 1
+                fut.set_result(None)
+                del self._eval_pending[key]
+        # read BEFORE evicting: a peer several iterations ahead may evict
+        # this key between the computing coroutine's set_result and a
+        # waiter resuming (step() orders its reads the same way)
+        err = self._eval_cache[key]
+        for old in [k for k in self._eval_cache if k[0] < it - 3]:
+            self._eval_cache.pop(old, None)
+        return err
 
 
 async def run_cluster(cfg_base, mesh, iterations: int, log_dir: str = ""):

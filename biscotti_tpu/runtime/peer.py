@@ -1609,8 +1609,15 @@ class PeerAgent:
         # same height and the chain-equality oracle holds (the reference
         # likewise scores the shared global data, ref: honest.go:141-162)
         with self.phases.phase("metrics"):
-            err = await asyncio.to_thread(self.trainer.test_error,
-                                          self.chain.latest_gradient())
+            if self.stepper is not None and hasattr(self.stepper,
+                                                    "test_error"):
+                # co-located peers share one evaluation: identical model ×
+                # identical global split (the uniformity the oracle needs)
+                err = await self.stepper.test_error(
+                    self.chain.latest_gradient(), it)
+            else:
+                err = await asyncio.to_thread(self.trainer.test_error,
+                                              self.chain.latest_gradient())
         self.logs.append((it, err, time.time()))
         self._trace("round_end", error=err)
         if err < cfg.convergence_error:

@@ -533,7 +533,10 @@ int msm_core(const uint8_t *scalars, const uint8_t *signs,
   // reproduces the classic high→low single-bucket-table sweep exactly.
   std::vector<ge> wsum(nwin, ge_identity());
   std::vector<uint8_t> wset(nwin, 0);
-  parallel_slices((size_t)nwin, 1, [&](size_t wlo, size_t whi) {
+  // window-level threads only pay off when each window holds real work;
+  // small MSMs (single scalar mults, tiny batches) stay serial
+  const size_t min_windows = n >= 65536 ? 1 : (size_t)nwin;
+  parallel_slices((size_t)nwin, min_windows, [&](size_t wlo, size_t whi) {
     std::vector<ge> buckets(half);
     std::vector<bool> used(half);
     for (size_t w = wlo; w < whi; w++) {

@@ -50,6 +50,38 @@ def test_device_peers_mint_real_blocks():
     assert 1 <= stepper.batches <= 3
 
 
+def test_stepper_shared_metric_memoizes():
+    """The per-round convergence metric is computed once per distinct
+    (iteration, weights) and served to every co-located peer — the shared
+    eval the scale harness leans on (identical model × identical global
+    test split, peer.py's uniform-convergence requirement)."""
+    import numpy as np
+
+    mesh = _mesh()
+    n_dev = math.prod(mesh.devices.shape)
+    cfg = BiscottiConfig(
+        num_nodes=n_dev, dataset="creditcard", base_port=25530,
+        num_verifiers=1, num_miners=1, num_noisers=1, batch_size=8,
+        timeouts=FAST, seed=3,
+    )
+    stepper = BatchStepper(cfg, mesh)
+    w = np.zeros(stepper.num_params, np.float64)
+    w2 = np.ones(stepper.num_params, np.float64)
+
+    async def drive():
+        # n_dev peers ask for the same (it, w); then one divergent chain
+        a = await asyncio.gather(*(stepper.test_error(w, 0)
+                                   for _ in range(n_dev)))
+        b = await stepper.test_error(w2, 0)
+        c = await stepper.test_error(w, 1)
+        return a, b, c
+
+    a, b, c = asyncio.run(drive())
+    assert len(set(a)) == 1
+    assert stepper.evals == 3  # (0,w) shared by all peers; (0,w2); (1,w)
+    assert a[0] == c  # same weights at a later height: same value
+
+
 def test_device_cluster_with_secure_agg():
     mesh = _mesh()
     n_dev = math.prod(mesh.devices.shape)
