@@ -121,35 +121,45 @@ class BatchStepper:
         self._eval_pending: Dict[tuple, asyncio.Future] = {}
         self.evals = 0  # distinct metric computations (observability/tests)
 
+    async def _memo(self, cache: Dict, pending: Dict, key, compute):
+        """Single-flight async memo: the first caller computes off-loop,
+        every concurrent waiter receives the VALUE from the future itself
+        (never a post-await cache re-read — another peer far enough ahead
+        may evict the key between set_result and a waiter resuming), and
+        a failed compute raises in every caller."""
+        if key in cache:
+            return cache[key], False
+        if key in pending:
+            return await pending[key], False
+        fut = asyncio.get_running_loop().create_future()
+        pending[key] = fut
+        try:
+            val = await asyncio.to_thread(compute)
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()  # mark retrieved if nobody is waiting
+            del pending[key]
+            raise
+        cache[key] = val
+        fut.set_result(val)
+        del pending[key]
+        return val, True
+
     async def step(self, peer_id: int, w: np.ndarray, it: int) -> np.ndarray:
         """This peer's delta for iteration `it`; the first caller computes
         the whole batch on the mesh."""
         import jax.numpy as jnp
 
-        if it not in self._cache:
-            if it in self._pending:
-                # waiters share the computing coroutine's outcome — a
-                # failed dispatch raises HERE too, not a later KeyError
-                await self._pending[it]
-            else:
-                fut = asyncio.get_running_loop().create_future()
-                self._pending[it] = fut
-                try:
-                    deltas = await asyncio.to_thread(
-                        lambda: np.asarray(
-                            self._step(jnp.asarray(w, jnp.float32),
-                                       self._x, self._y, it),
-                            dtype=np.float64))
-                except BaseException as e:
-                    fut.set_exception(e)
-                    fut.exception()  # mark retrieved if nobody is waiting
-                    del self._pending[it]
-                    raise
-                self._cache[it] = deltas
-                self.batches += 1
-                fut.set_result(None)
-                del self._pending[it]
-        delta = self._cache[it][peer_id]
+        def compute():
+            return np.asarray(
+                self._step(jnp.asarray(w, jnp.float32), self._x, self._y,
+                           it), dtype=np.float64)
+
+        deltas, computed = await self._memo(self._cache, self._pending, it,
+                                            compute)
+        if computed:
+            self.batches += 1
+        delta = deltas[peer_id]
         self._served[it] = self._served.get(it, 0) + 1
         if self._served[it] >= self.cfg.num_nodes:
             self._cache.pop(it, None)  # everyone served: evict
@@ -168,30 +178,15 @@ class BatchStepper:
 
         wb = np.ascontiguousarray(w)
         key = (it, hashlib.sha1(wb.tobytes()).hexdigest())
-        if key not in self._eval_cache:
-            if key in self._eval_pending:
-                await self._eval_pending[key]
-            else:
-                fut = asyncio.get_running_loop().create_future()
-                self._eval_pending[key] = fut
-                try:
-                    err = await asyncio.to_thread(
-                        lambda: float(self._err_fn(
-                            jnp.asarray(wb, jnp.float32),
-                            self._x_test, self._y_test)))
-                except BaseException as e:
-                    fut.set_exception(e)
-                    fut.exception()  # mark retrieved if nobody is waiting
-                    del self._eval_pending[key]
-                    raise
-                self._eval_cache[key] = err
-                self.evals += 1
-                fut.set_result(None)
-                del self._eval_pending[key]
-        # read BEFORE evicting: a peer several iterations ahead may evict
-        # this key between the computing coroutine's set_result and a
-        # waiter resuming (step() orders its reads the same way)
-        err = self._eval_cache[key]
+
+        def compute():
+            return float(self._err_fn(jnp.asarray(wb, jnp.float32),
+                                      self._x_test, self._y_test))
+
+        err, computed = await self._memo(self._eval_cache,
+                                         self._eval_pending, key, compute)
+        if computed:
+            self.evals += 1
         for old in [k for k in self._eval_cache if k[0] < it - 3]:
             self._eval_cache.pop(old, None)
         return err

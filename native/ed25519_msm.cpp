@@ -26,6 +26,10 @@
 #include <thread>
 #include <vector>
 
+#if defined(__AVX512IFMA__) && defined(__AVX512F__) && defined(__AVX512DQ__)
+#include <immintrin.h>
+#endif
+
 namespace {
 
 typedef unsigned __int128 u128;
@@ -419,6 +423,220 @@ void parallel_slices(size_t n, size_t min_per_thread,
   for (auto &th : ths) th.join();
 }
 
+// ------------------------------------------------------ AVX-512 IFMA lanes
+//
+// 8-point-wide vertical vectorization of the 5×51-bit field arithmetic:
+// fe8 lane l is point l's limb vector, in the SAME radix-51 representation
+// as the scalar `fe` (lane↔scalar conversion is a pure transpose, no
+// re-encoding). vpmadd52{lo,hi} multiply the LOW 52 BITS of each operand —
+// radix-51 limbs with ≤ 2^51+ε normalization leave one bit of headroom, so
+// every multiplier operand below is < 2^52 by construction (bounds at each
+// op). The product of two 51-bit-radix limbs splits at bit 52, i.e. the
+// hi part sits at 2^(51(i+j)+52) = 2·2^(51(i+j+1)) — accumulated hi
+// columns are DOUBLED before joining the lo columns.
+//
+// Compiled in when the build host has IFMA (-march=native); scalar paths
+// remain the fallback and the reference for differential tests
+// (BISCOTTI_NO_IFMA=1 forces them at runtime, test_native cross-checks).
+
+#if defined(__AVX512IFMA__) && defined(__AVX512F__) && defined(__AVX512DQ__)
+#define BISCOTTI_IFMA 1
+
+namespace {
+
+struct fe8 {
+  __m512i v[5];
+};
+
+inline __m512i m512_set1(uint64_t x) {
+  return _mm512_set1_epi64((long long)x);
+}
+
+inline bool ifma_enabled() {
+  static const bool on = [] {
+    const char *e = getenv("BISCOTTI_NO_IFMA");
+    return !(e && e[0] == '1');
+  }();
+  return on;
+}
+
+// carry-normalize: input limbs < 2^63, output limbs ≤ 2^51 + 2^13 (valid
+// madd52 operand, < 2^52) — mirrors scalar fe_carry exactly
+inline fe8 fe8_carry(fe8 a) {
+  const __m512i mask = m512_set1(MASK51);
+  const __m512i n19 = m512_set1(19);
+  __m512i c;
+  for (int i = 0; i < 4; i++) {
+    c = _mm512_srli_epi64(a.v[i], 51);
+    a.v[i] = _mm512_and_epi64(a.v[i], mask);
+    a.v[i + 1] = _mm512_add_epi64(a.v[i + 1], c);
+  }
+  c = _mm512_srli_epi64(a.v[4], 51);
+  a.v[4] = _mm512_and_epi64(a.v[4], mask);
+  a.v[0] = _mm512_add_epi64(a.v[0], _mm512_mullo_epi64(c, n19));
+  c = _mm512_srli_epi64(a.v[0], 51);
+  a.v[0] = _mm512_and_epi64(a.v[0], mask);
+  a.v[1] = _mm512_add_epi64(a.v[1], c);
+  return a;
+}
+
+// a + b, carried (both operands normalized ≤ 2^51+2^13; sum < 2^53)
+inline fe8 fe8_add(const fe8 &a, const fe8 &b) {
+  fe8 r;
+  for (int i = 0; i < 5; i++) r.v[i] = _mm512_add_epi64(a.v[i], b.v[i]);
+  return fe8_carry(r);
+}
+
+// a − b + 2p, carried (b normalized; the 2p bias keeps lanes non-negative
+// — same constants as scalar fe_sub)
+inline fe8 fe8_sub(const fe8 &a, const fe8 &b) {
+  static const uint64_t BIAS[5] = {0xFFFFFFFFFFFDAULL, 0xFFFFFFFFFFFFEULL,
+                                   0xFFFFFFFFFFFFEULL, 0xFFFFFFFFFFFFEULL,
+                                   0xFFFFFFFFFFFFEULL};
+  fe8 r;
+  for (int i = 0; i < 5; i++)
+    r.v[i] = _mm512_sub_epi64(_mm512_add_epi64(a.v[i], m512_set1(BIAS[i])),
+                              b.v[i]);
+  return fe8_carry(r);
+}
+
+// schoolbook 5×5 with vpmadd52, hi columns doubled (see section header),
+// ×19 fold of columns ≥ 5, then the scalar fe_mul's exact carry tail.
+// Operand limbs MUST be < 2^52 (madd52 truncates); outputs ≤ 2^51 + 1.
+inline fe8 fe8_mul(const fe8 &a, const fe8 &b) {
+  const __m512i zero = _mm512_setzero_si512();
+  // flat accumulators: 25 lo-madds across 9 independent columns give the
+  // scheduler parallel chains (a column-wise rewrite measured ~30% SLOWER
+  // — each column's madds serialize on one accumulator's 4-cycle latency)
+  __m512i lo[9], hi[10];
+  for (int k = 0; k < 9; k++) lo[k] = zero;
+  for (int k = 0; k < 10; k++) hi[k] = zero;
+  for (int i = 0; i < 5; i++)
+    for (int j = 0; j < 5; j++) {
+      lo[i + j] = _mm512_madd52lo_epu64(lo[i + j], a.v[i], b.v[j]);
+      hi[i + j + 1] = _mm512_madd52hi_epu64(hi[i + j + 1], a.v[i], b.v[j]);
+    }
+  // t[k] = lo[k] + 2·hi[k] (hi doubled: radix-51 limb products split at
+  // bit 52 = 2·2^51); columns < 5·2^52 + 2·5·2^52 < 2^56
+  __m512i t[10];
+  for (int k = 0; k < 9; k++)
+    t[k] = _mm512_add_epi64(lo[k], _mm512_add_epi64(hi[k], hi[k]));
+  t[9] = _mm512_add_epi64(hi[9], hi[9]);
+  // fold: value ≡ Σ_{k<5} (t[k] + 19·t[k+5])·2^51k; 19·2^56 < 2^61
+  const __m512i n19 = m512_set1(19);
+  fe8 r;
+  for (int k = 0; k < 5; k++)
+    r.v[k] = _mm512_add_epi64(t[k], _mm512_mullo_epi64(t[k + 5], n19));
+  return fe8_carry(r);
+}
+
+inline fe8 fe8_sq(const fe8 &a) { return fe8_mul(a, a); }
+
+// lane transpose: 8 scalar fes → one fe8 (and back)
+inline fe8 fe8_from_lanes(const fe lanes[8]) {
+  alignas(64) uint64_t buf[5][8];
+  for (int l = 0; l < 8; l++)
+    for (int i = 0; i < 5; i++) buf[i][l] = lanes[l].v[i];
+  fe8 r;
+  for (int i = 0; i < 5; i++)
+    r.v[i] = _mm512_load_si512((const void *)buf[i]);
+  return r;
+}
+
+inline void fe8_to_lanes(const fe8 &a, fe lanes[8]) {
+  alignas(64) uint64_t buf[5][8];
+  for (int i = 0; i < 5; i++)
+    _mm512_store_si512((void *)buf[i], a.v[i]);
+  for (int l = 0; l < 8; l++)
+    for (int i = 0; i < 5; i++) lanes[l].v[i] = buf[i][l];
+}
+
+inline fe8 fe8_splat(const fe &a) {
+  fe8 r;
+  for (int i = 0; i < 5; i++) r.v[i] = m512_set1(a.v[i]);
+  return r;
+}
+
+// per-lane equality mod p: freeze both to canonical limbs (carry twice +
+// one conditional subtract of p, the scalar fe_canon vectorized) and
+// compare — returns a lane mask
+inline __mmask8 fe8_eq_mask(const fe8 &a, const fe8 &b) {
+  const __m512i mask = m512_set1(MASK51);
+  const __m512i p0 = m512_set1(MASK51 - 18);
+  auto canon = [&](fe8 t) {
+    t = fe8_carry(fe8_carry(t));
+    // value < 2p: subtract p iff limbs ≥ p
+    __mmask8 ge = _mm512_cmpge_epu64_mask(t.v[0], p0);
+    for (int i = 1; i < 5; i++)
+      ge &= _mm512_cmpeq_epu64_mask(t.v[i], mask);
+    t.v[0] = _mm512_mask_sub_epi64(t.v[0], ge, t.v[0], p0);
+    for (int i = 1; i < 5; i++)
+      t.v[i] = _mm512_mask_sub_epi64(t.v[i], ge, t.v[i], mask);
+    return t;
+  };
+  fe8 ca = canon(a), cb = canon(b);
+  __mmask8 eq = 0xFF;
+  for (int i = 0; i < 5; i++)
+    eq &= _mm512_cmpeq_epu64_mask(ca.v[i], cb.v[i]);
+  return eq;
+}
+
+struct ge8 {
+  fe8 X, Y, Z, T;
+};
+struct nge8 {
+  fe8 YpX, YmX, T2d;
+};
+
+// Gather one niels table entry per lane into 8-lane form. `offs` holds
+// per-lane BYTE offsets of the entry (entry_index·sizeof(nge)); `mask`
+// lanes gather, the rest read the identity defaults (1, 1, 0) — a no-op
+// through ge8_madd, mirroring the scalar loops' skip-on-zero-window.
+// offs_a/offs_b differ only for negated lanes (YpX/YmX sources swapped);
+// neg lanes additionally negate T2d (niels negation).
+inline nge8 nge8_gather(const nge *table, __m512i offs_a, __m512i offs_b,
+                        __m512i offs_t, __mmask8 mask, __mmask8 neg) {
+  const __m512i one = m512_set1(1);
+  const __m512i zero = _mm512_setzero_si512();
+  const char *base = reinterpret_cast<const char *>(table);
+  nge8 r;
+  for (int i = 0; i < 5; i++) {
+    r.YpX.v[i] = _mm512_mask_i64gather_epi64(
+        i == 0 ? one : zero, mask, offs_a, base + 8 * i, 1);
+    r.YmX.v[i] = _mm512_mask_i64gather_epi64(
+        i == 0 ? one : zero, mask, offs_b, base + 8 * i, 1);
+    r.T2d.v[i] = _mm512_mask_i64gather_epi64(zero, mask, offs_t,
+                                             base + 80 + 8 * i, 1);
+  }
+  if (neg) {
+    // niels negation: T2d ← −T2d (the YpX/YmX swap already rode the
+    // offset registers); identity lanes hold 0, whose negation is ≡ 0
+    fe8 nt = fe8_sub(fe8_splat(fe_zero()), r.T2d);
+    for (int i = 0; i < 5; i++)
+      r.T2d.v[i] = _mm512_mask_blend_epi64(neg, r.T2d.v[i], nt.v[i]);
+  }
+  return r;
+}
+
+// r = p + q (q in 8-lane niels form) — the scalar ge_madd with explicit
+// carries (every fe8_mul operand must be < 2^52; fe8_add/sub carry
+// internally, so the scalar file's lazy-depth bookkeeping is not needed)
+inline ge8 ge8_madd(const ge8 &p, const nge8 &q) {
+  fe8 a = fe8_mul(fe8_sub(p.Y, p.X), q.YmX);
+  fe8 b = fe8_mul(fe8_add(p.Y, p.X), q.YpX);
+  fe8 c = fe8_mul(p.T, q.T2d);
+  fe8 d = fe8_add(p.Z, p.Z);
+  fe8 e = fe8_sub(b, a);
+  fe8 f = fe8_sub(d, c);
+  fe8 g = fe8_add(d, c);
+  fe8 h = fe8_add(b, a);
+  return ge8{fe8_mul(e, f), fe8_mul(g, h), fe8_mul(f, g), fe8_mul(e, h)};
+}
+
+}  // namespace
+
+#endif  // BISCOTTI_IFMA
+
 }  // namespace
 
 // ------------------------------------------------------------------- C ABI
@@ -643,20 +861,22 @@ int ed25519_scalarmult(const uint8_t *scalar, const uint8_t *point,
 // fold the cofactor 8 into their verification scalars). On success fills
 // x, y and the t = x·y product (already needed by the curve equation,
 // reused by callers for extended/niels forms).
+// canonical (< p) via four u64 words — branch-light, no byte loop; shared
+// by the scalar validator and the IFMA group loader
+static inline bool canonical_fe_bytes(const uint8_t *b) {
+  uint64_t w0, w1, w2, w3;
+  memcpy(&w0, b, 8);
+  memcpy(&w1, b + 8, 8);
+  memcpy(&w2, b + 16, 8);
+  memcpy(&w3, b + 24, 8);
+  if (w3 != 0x7FFFFFFFFFFFFFFFULL) return w3 < 0x7FFFFFFFFFFFFFFFULL;
+  if ((w2 & w1) != ~0ULL) return true;
+  return w0 < 0xFFFFFFFFFFFFFFEDULL;
+}
+
 static bool load_affine_checked(const uint8_t *xb, fe &x, fe &y, fe &t) {
-  // canonical (< p) via four u64 words — branch-light, no byte loop
-  auto canonical = [](const uint8_t *b) {
-    uint64_t w0, w1, w2, w3;
-    memcpy(&w0, b, 8);
-    memcpy(&w1, b + 8, 8);
-    memcpy(&w2, b + 16, 8);
-    memcpy(&w3, b + 24, 8);
-    if (w3 != 0x7FFFFFFFFFFFFFFFULL) return w3 < 0x7FFFFFFFFFFFFFFFULL;
-    if ((w2 & w1) != ~0ULL) return true;
-    return w0 < 0xFFFFFFFFFFFFFFEDULL;
-  };
   const uint8_t *yb = xb + 32;
-  if (!canonical(xb) || !canonical(yb)) return false;
+  if (!canonical_fe_bytes(xb) || !canonical_fe_bytes(yb)) return false;
   x = fe_frombytes(xb);
   y = fe_frombytes(yb);
   t = fe_mul(x, y);
@@ -705,18 +925,101 @@ int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
   // reported index does not matter — biscotti_tpu/crypto/_native.py
   // load_xy_sum discards it).
   std::atomic<size_t> first_bad{SIZE_MAX};
+  auto record_bad = [&first_bad](size_t idx) {
+    size_t cur = first_bad.load(std::memory_order_relaxed);
+    while (idx < cur && !first_bad.compare_exchange_weak(cur, idx)) {
+    }
+  };
   parallel_slices(n, 2048, [&](size_t lo, size_t hi) {
+#ifdef BISCOTTI_IFMA
+    if (ifma_enabled()) {
+      // 8 points per step: canonicality stays scalar (u64 compares), the
+      // curve-equation check and the niels accumulation run 8 lanes wide
+      const size_t m = hi - lo;
+      const size_t g8 = m / 8;  // full vector groups; tail runs scalar
+      std::vector<ge8> acc8(g8);
+      std::vector<ge> acct(m - g8 * 8);
+      const fe8 d8 = fe8_splat(consts().d);
+      const fe8 one8 = fe8_splat(fe_one());
+      const fe8 d2_8 = fe8_splat(D2);
+      for (size_t b = 0; b < n_batches; b++) {
+        if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
+        for (size_t g = 0; g < g8; g++) {
+          const size_t base = lo + g * 8;
+          fe xs_[8], ys_[8];
+          for (int l = 0; l < 8; l++) {
+            const uint8_t *pb = xy + (b * n + base + l) * 64;
+            if (!canonical_fe_bytes(pb) || !canonical_fe_bytes(pb + 32)) {
+              record_bad(b * n + base + l);
+              return;
+            }
+            xs_[l] = fe_frombytes(pb);
+            ys_[l] = fe_frombytes(pb + 32);
+          }
+          fe8 x8 = fe8_from_lanes(xs_);
+          fe8 y8 = fe8_from_lanes(ys_);
+          fe8 t8 = fe8_mul(x8, y8);
+          fe8 lhs = fe8_sub(fe8_sq(y8), fe8_sq(x8));
+          fe8 rhs = fe8_add(one8, fe8_mul(d8, fe8_sq(t8)));
+          __mmask8 eq = fe8_eq_mask(lhs, rhs);
+          if (eq != 0xFF) {
+            record_bad(b * n + base +
+                       __builtin_ctz((unsigned)(~eq) & 0xFFu));
+            return;
+          }
+          if (b == 0) {
+            acc8[g] = ge8{x8, y8, one8, t8};
+          } else {
+            nge8 q{fe8_add(y8, x8), fe8_sub(y8, x8), fe8_mul(t8, d2_8)};
+            acc8[g] = ge8_madd(acc8[g], q);
+          }
+        }
+        for (size_t i = lo + g8 * 8; i < hi; i++) {
+          fe x, y, t;
+          if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t)) {
+            record_bad(b * n + i);
+            return;
+          }
+          if (b == 0) {
+            acct[i - lo - g8 * 8] = ge{x, y, fe_one(), t};
+          } else {
+            nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
+            acct[i - lo - g8 * 8] = ge_madd(acct[i - lo - g8 * 8], q);
+          }
+        }
+      }
+      for (size_t g = 0; g < g8; g++) {
+        fe lx[8], ly[8], lz[8], lt[8];
+        fe8_to_lanes(acc8[g].X, lx);
+        fe8_to_lanes(acc8[g].Y, ly);
+        fe8_to_lanes(acc8[g].Z, lz);
+        fe8_to_lanes(acc8[g].T, lt);
+        for (int l = 0; l < 8; l++) {
+          uint8_t *o = out + (lo + g * 8 + l) * 128;
+          fe_tobytes(o, lx[l]);
+          fe_tobytes(o + 32, ly[l]);
+          fe_tobytes(o + 64, lz[l]);
+          fe_tobytes(o + 96, lt[l]);
+        }
+      }
+      for (size_t i = lo + g8 * 8; i < hi; i++) {
+        uint8_t *o = out + i * 128;
+        const ge &a = acct[i - lo - g8 * 8];
+        fe_tobytes(o, a.X);
+        fe_tobytes(o + 32, a.Y);
+        fe_tobytes(o + 64, a.Z);
+        fe_tobytes(o + 96, a.T);
+      }
+      return;
+    }
+#endif
     std::vector<ge> acc(hi - lo);
     for (size_t b = 0; b < n_batches; b++) {
       if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
       for (size_t i = lo; i < hi; i++) {
         fe x, y, t;
         if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t)) {
-          size_t idx = b * n + i;
-          size_t cur = first_bad.load(std::memory_order_relaxed);
-          while (idx < cur &&
-                 !first_bad.compare_exchange_weak(cur, idx)) {
-          }
+          record_bad(b * n + i);
           return;
         }
         if (b == 0) {
@@ -1206,6 +1509,105 @@ int batch_commit_core(const uint8_t *a_scalars, const uint8_t *a_signs,
   constexpr size_t LANES = 4;
   parallel_slices(n, 512, [&](size_t lo, size_t hi) {
     std::vector<ge> res(hi - lo);
+#ifdef BISCOTTI_IFMA
+    if (ifma_enabled() && !h_byte) {
+      // 8 commits per step: per window, ONE masked 8-lane table gather
+      // (identity defaults on zero windows) and ONE 8-wide mixed add —
+      // the gathers keep 8 table-cache misses in flight where the scalar
+      // chain serialized on each one. Commits whose data magnitude
+      // exceeds 8 bytes (full-width scalars, e.g. base_mult callers) and
+      // the <8 tail fall back to the scalar group below.
+      const fe8 one8 = fe8_splat(fe_one());
+      const fe8 zero8 = fe8_splat(fe_zero());
+      size_t i0 = lo;
+      for (; i0 + 8 <= hi; i0 += 8) {
+        bool wide = false;
+        for (size_t l = 0; l < 8 && !wide; l++) {
+          const uint8_t *a = a_scalars + (i0 + l) * 32;
+          for (int j = 8; j < 32; j++) wide |= a[j] != 0;
+        }
+        if (wide) break;  // rare; finish the slice on the scalar path
+        ge8 acc{zero8, one8, one8, zero8};
+        alignas(64) long long offa[8], offb[8], offt[8];
+        if (comb_h) {
+          for (int j = 0; j < 16; j++) {
+            __mmask8 mask = 0;
+            for (size_t l = 0; l < 8; l++) {
+              const uint8_t *b = b_scalars + (i0 + l) * 32;
+              uint32_t v =
+                  (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
+              if (v) mask |= (uint8_t)(1u << l);
+              long long e =
+                  (long long)((size_t)j * 65536 + v) * (long long)sizeof(nge);
+              offa[l] = e;
+              offb[l] = e + 40;
+              offt[l] = e;
+            }
+            nge8 q = nge8_gather(comb_h, _mm512_load_si512(offa),
+                                 _mm512_load_si512(offb),
+                                 _mm512_load_si512(offt), mask, 0);
+            acc = ge8_madd(acc, q);
+          }
+        }
+        for (int j = 0; j < 8; j++) {
+          __mmask8 mask = 0, neg = 0;
+          for (size_t l = 0; l < 8; l++) {
+            uint8_t av = a_scalars[(i0 + l) * 32 + j];
+            bool s = a_signs && a_signs[i0 + l];
+            if (av) {
+              mask |= (uint8_t)(1u << l);
+              if (s) neg |= (uint8_t)(1u << l);
+            }
+            long long e =
+                (long long)((size_t)j * 256 + av) * (long long)sizeof(nge);
+            offa[l] = e + (s ? 40 : 0);
+            offb[l] = e + (s ? 0 : 40);
+            offt[l] = e;
+          }
+          nge8 q = nge8_gather(comb_g, _mm512_load_si512(offa),
+                               _mm512_load_si512(offb),
+                               _mm512_load_si512(offt), mask, neg);
+          acc = ge8_madd(acc, q);
+        }
+        fe lx[8], ly[8], lz[8], lt[8];
+        fe8_to_lanes(acc.X, lx);
+        fe8_to_lanes(acc.Y, ly);
+        fe8_to_lanes(acc.Z, lz);
+        fe8_to_lanes(acc.T, lt);
+        for (size_t l = 0; l < 8; l++)
+          res[i0 + l - lo] = ge{lx[l], ly[l], lz[l], lt[l]};
+      }
+      // scalar finish: the <8 tail, or a group containing a wide scalar
+      for (; i0 < hi; i0++) {
+        ge acc = ge_identity();
+        const uint8_t *b = b_scalars + i0 * 32;
+        if (comb_h)
+          for (int j = 0; j < 16; j++) {
+            uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
+            if (v) acc = ge_madd(acc, comb_h[(size_t)j * 65536 + v]);
+          }
+        const uint8_t *a = a_scalars + i0 * 32;
+        bool neg = a_signs && a_signs[i0];
+        for (int j = 0; j < 32; j++) {
+          uint8_t av = a[j];
+          if (av) {
+            const nge &e = comb_g[j * 256 + av];
+            acc = neg ? ge_msub(acc, e) : ge_madd(acc, e);
+          }
+        }
+        res[i0 - lo] = acc;
+      }
+      std::vector<fe> zinv;
+      ge_batch_zinv(res, zinv);
+      for (size_t i = lo; i < hi; i++) {
+        fe x = fe_mul(res[i - lo].X, zinv[i - lo]);
+        fe y = fe_mul(res[i - lo].Y, zinv[i - lo]);
+        fe_tobytes(out + i * 64, x);
+        fe_tobytes(out + i * 64 + 32, y);
+      }
+      return;
+    }
+#endif
     for (size_t i0 = lo; i0 < hi; i0 += LANES) {
       const size_t m = std::min(LANES, hi - i0);
       // prefetch the NEXT group's H16 entries a whole group (~20 µs of

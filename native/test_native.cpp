@@ -26,7 +26,12 @@ int ed25519_msm_signed(const uint8_t *scalars, const uint8_t *signs,
 int ed25519_batch_commit(const uint8_t *a, const uint8_t *b,
                          const uint8_t *g, const uint8_t *h, size_t n,
                          uint8_t *out);
+int ed25519_batch_commit_signed(const uint8_t *a_mags, const uint8_t *a_signs,
+                                const uint8_t *b, const uint8_t *g,
+                                const uint8_t *h, size_t n, uint8_t *out);
 int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out);
+int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
+                        uint8_t *out);
 int ed25519_vss_rlc_scalars(const int64_t *xs, const uint64_t *gammas,
                             size_t S, size_t C, size_t k,
                             uint8_t *out_scalars, uint8_t *out_signs);
@@ -115,6 +120,86 @@ void test_group_identities() {
   check(signs3[0] == 0 && signs3[1] == 1 && signs3[2] == 0, "rlc signs");
 }
 
+// Differential check of the batched validate+sum (the IFMA group path
+// when the build host has AVX-512 IFMA; tail lanes + scalar otherwise):
+// three batches of known G-multiples must sum per-point to the multiple
+// computed by the INDEPENDENT fixed-base comb path, and one corrupted
+// point anywhere must reject the whole set.
+void test_load_xy_sum() {
+  const size_t n = 21;  // 2 full 8-lanes + a 5-point tail
+  uint8_t zero[32] = {0};
+  uint8_t base[128];
+  extended_of_base(base);
+  std::vector<uint8_t> batches(3 * n * 64), expect(n * 64);
+  for (size_t b = 0; b < 3; b++)
+    for (size_t i = 0; i < n; i++) {
+      uint8_t s[32];
+      scalar_bytes(1 + b * 1000003u + i * 7919u, s);
+      check(ed25519_batch_commit(s, zero, base, base, 1,
+                                 batches.data() + (b * n + i) * 64) == 0,
+            "sum fixture commit");
+    }
+  for (size_t i = 0; i < n; i++) {
+    uint8_t s[32];
+    scalar_bytes(3 + 3 * 1000003u + 3 * i * 7919u, s);  // Σ_b (1+b·M+i·K)
+    check(ed25519_batch_commit(s, zero, base, base, 1,
+                               expect.data() + i * 64) == 0,
+          "sum expectation commit");
+  }
+  std::vector<uint8_t> summed(n * 128);
+  check(ed25519_load_xy_sum(batches.data(), 3, n, summed.data()) == 0,
+        "load_xy_sum runs");
+  uint8_t one[32];
+  scalar_bytes(1, one);
+  for (size_t i = 0; i < n; i++) {
+    uint8_t aff[64];
+    check(ed25519_msm(one, summed.data() + i * 128, 1, aff) == 0,
+          "sum affine");
+    check(memcmp(aff, expect.data() + i * 64, 64) == 0,
+          "load_xy_sum == comb sum");
+  }
+  // corruption in the middle of batch 2, lane 3 of a vector group
+  batches[(2 * n + 11) * 64 + 5] ^= 0x40;
+  check(ed25519_load_xy_sum(batches.data(), 3, n, summed.data()) != 0,
+        "corrupted point rejected");
+}
+
+// Differential check of the grouped commit path (8-lane gathered combs on
+// IFMA hosts): a 21-commit batch — 2 full groups + a 5-commit tail — must
+// equal the same commits issued one at a time (n=1 always takes the
+// scalar chain), covering signs, zero windows, and dense blinds.
+void test_batch_commit_groups() {
+  const size_t n = 21;
+  uint8_t base[128], h[128];
+  extended_of_base(base);
+  // independent H: use 3·G so the two comb tables differ
+  uint8_t s3[32], h_aff[64];
+  scalar_bytes(3, s3);
+  check(ed25519_msm(s3, base, 1, h_aff) == 0, "3G");
+  check(ed25519_load_xy_batch(h_aff, 1, h) == 0, "3G loads");
+  std::vector<uint8_t> mags(n * 32, 0), signs(n, 0), blinds(n * 32, 0);
+  for (size_t i = 0; i < n; i++) {
+    uint64_t m = (i == 7) ? 0 : 0x1234567u * (uint64_t)(i + 1);
+    memcpy(&mags[i * 32], &m, 8);
+    signs[i] = i % 3 == 1 ? 1 : 0;
+    for (int j = 0; j < 32; j++)
+      blinds[i * 32 + j] =
+          i == 5 ? 0 : (uint8_t)(31 * i + 7 * j + 1);  // one zero blind
+    blinds[i * 32 + 31] &= 0x0F;  // canonical < q
+  }
+  std::vector<uint8_t> got(n * 64), want(n * 64);
+  check(ed25519_batch_commit_signed(mags.data(), signs.data(), blinds.data(),
+                                    base, h, n, got.data()) == 0,
+        "grouped commit");
+  for (size_t i = 0; i < n; i++)
+    check(ed25519_batch_commit_signed(mags.data() + i * 32, signs.data() + i,
+                                      blinds.data() + i * 32, base, h, 1,
+                                      want.data() + i * 64) == 0,
+          "single commit");
+  check(memcmp(got.data(), want.data(), n * 64) == 0,
+        "grouped == singles");
+}
+
 void hammer_thread() {
   uint8_t base[128];
   extended_of_base(base);
@@ -132,6 +217,8 @@ void hammer_thread() {
 
 int main() {
   test_group_identities();
+  test_load_xy_sum();
+  test_batch_commit_groups();
   std::vector<std::thread> ts;
   for (int i = 0; i < 4; i++) ts.emplace_back(hammer_thread);
   for (auto &t : ts) t.join();
