@@ -454,16 +454,48 @@ def eval_poly(coeffs: Sequence[int], x: int) -> int:
 # kyber.go:650-673). On failure, per-worker fallback identifies the cheat.
 
 
+# Pedersen blind width in bits. BINDING (what VSS soundness rests on) is
+# independent of this; it sets the HIDING level of each coefficient
+# commitment. 128-bit blinds give ≥2⁶⁴-operation generic hiding (interval
+# kangaroo over [0, 2¹²⁸)) at HALF the comb windows and XOF bytes of full-
+# width blinds — and remain categorically stronger than the reference,
+# whose commitments carry no blinding at all (C = Σ qᵢ·PKᵢ,
+# kyber.go:533-562). Set BISCOTTI_HIDING_BITS=252 for full-width
+# (statistically perfect) hiding.
+def _hiding_bits_from_env() -> int:
+    import os
+
+    raw = os.environ.get("BISCOTTI_HIDING_BITS", "128")
+    try:
+        v = int(raw)
+    except ValueError:
+        import sys
+
+        print(f"[commitments] ignoring non-integer BISCOTTI_HIDING_BITS="
+              f"{raw!r}; using 128", file=sys.stderr)
+        v = 128
+    return max(8, min(252, v))
+
+
+HIDING_BITS = _hiding_bits_from_env()
+
+
 def vss_blind_bytes(n: int, seed: bytes, context: bytes) -> bytes:
     """n blinding coefficients as packed 32-byte little-endian canonical
-    Z_q values, from ONE SHAKE-256 XOF call: each 32-byte window is masked
-    to 252 bits, giving a value uniform in [0, 2²⁵²) — statistical
-    distance < 2⁻¹²⁸ from uniform mod q (q = 2²⁵² + δ, δ ≈ 2¹²⁴), which
-    the hiding property needs, with zero python bigint traffic."""
-    raw = bytearray(hashlib.shake_256(
-        seed + b"vss-blind-xof" + context).digest(32 * n))
+    Z_q values, from ONE SHAKE-256 XOF call. At HIDING_BITS=252 each
+    value is uniform in [0, 2²⁵²) — statistical distance < 2⁻¹²⁸ from
+    uniform mod q (q = 2²⁵² + δ, δ ≈ 2¹²⁴); narrower widths trade
+    statistical hiding for computational hiding (see HIDING_BITS) and
+    draw proportionally fewer XOF bytes. Zero python bigint traffic."""
+    nbytes = (HIDING_BITS + 7) // 8
+    raw = bytearray(32 * n)
+    xof = hashlib.shake_256(seed + b"vss-blind-xof" + context).digest(
+        nbytes * n)
     arr = np.frombuffer(raw, dtype=np.uint8).reshape(n, 32)
-    arr[:, 31] &= 0x0F  # mask to 252 bits → canonical < q
+    arr[:, :nbytes] = np.frombuffer(xof, dtype=np.uint8).reshape(n, nbytes)
+    # mask the top partial byte (252 → 0x0F etc.); value < 2^HIDING_BITS
+    # ≤ 2²⁵² < q, so every emitted field is canonical
+    arr[:, nbytes - 1] &= (0xFF >> (-HIDING_BITS % 8))
     return bytes(raw)
 
 

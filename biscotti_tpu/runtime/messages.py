@@ -31,25 +31,44 @@ class CodecError(ValueError):
     pass
 
 
-def encode(msg_type: str, meta: Dict[str, Any] | None = None,
-           arrays: Dict[str, np.ndarray] | None = None) -> bytes:
+def encode_parts(msg_type: str, meta: Dict[str, Any] | None = None,
+                 arrays: Dict[str, np.ndarray] | None = None) -> list:
+    """Frame as a list of buffers (prefix + header + one memoryview per
+    array) for part-wise transport writes — multi-MB payloads (VSS
+    commitment tensors, model weights) never get flattened into one big
+    bytearray on the event loop. The views alias the caller's arrays:
+    callers must not mutate an array between handing it to the codec and
+    the write draining (protocol code treats packed arrays as immutable —
+    fresh per round)."""
     meta = meta or {}
     arrays = arrays or {}
     descs = []
     blobs = []
+    nbytes = 0
     for name, arr in arrays.items():
         arr = np.ascontiguousarray(arr)
         if arr.dtype.name not in _ALLOWED_DTYPES:
             raise CodecError(f"dtype {arr.dtype} not allowed on the wire")
         descs.append({"name": name, "dtype": arr.dtype.name,
                       "shape": list(arr.shape)})
-        blobs.append(arr.tobytes())
+        mv = memoryview(arr).cast("B")
+        blobs.append(mv)
+        nbytes += len(mv)
     header = json.dumps({"type": msg_type, "meta": meta, "arrays": descs},
                         separators=(",", ":")).encode()
-    payload = struct.pack(">I", len(header)) + header + b"".join(blobs)
-    if len(payload) + 4 > MAX_FRAME:
+    total = 4 + len(header) + nbytes
+    if total + 4 > MAX_FRAME:
         raise CodecError("frame too large")
-    return struct.pack(">I", len(payload)) + payload
+    return [struct.pack(">I", total), struct.pack(">I", len(header)),
+            header] + blobs
+
+
+def encode(msg_type: str, meta: Dict[str, Any] | None = None,
+           arrays: Dict[str, np.ndarray] | None = None) -> bytes:
+    """One contiguous frame — for pre-encoded broadcast frames written to
+    many peers (encode once, write N times); per-call paths use
+    encode_parts."""
+    return b"".join(encode_parts(msg_type, meta, arrays))
 
 
 def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
@@ -68,6 +87,7 @@ def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
         if not isinstance(msg_type, str) or not isinstance(meta, dict):
             raise CodecError("malformed header")
         arrays: Dict[str, np.ndarray] = {}
+        mv = memoryview(payload)
         off = 4 + hlen
         for desc in header.get("arrays", []):
             dtype = desc["dtype"]
@@ -80,9 +100,12 @@ def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
             nbytes = count * np.dtype(dtype).itemsize
             if off + nbytes > len(payload):
                 raise CodecError("array bytes exceed frame")
+            # zero-copy READ-ONLY view into the frame (frombuffer over
+            # bytes is non-writable): receivers that need a mutable or
+            # differently-typed array copy at their own call site; an
+            # accidental in-place write raises instead of corrupting
             arrays[desc["name"]] = np.frombuffer(
-                payload[off : off + nbytes], dtype=dtype
-            ).reshape(shape).copy()
+                mv[off: off + nbytes], dtype=dtype).reshape(shape)
             off += nbytes
         return msg_type, meta, arrays
     except CodecError:

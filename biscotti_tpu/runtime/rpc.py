@@ -125,10 +125,11 @@ class RPCServer:
             rmeta, rarrays = {"error": f"internal: {type(e).__name__}: {e}"}, {}
         rmeta = dict(rmeta)
         rmeta["rid"] = rid
-        frame = msgs.encode(msg_type + ".reply", rmeta, rarrays)
+        parts = msgs.encode_parts(msg_type + ".reply", rmeta, rarrays)
         async with write_lock:
             try:
-                writer.write(frame)
+                for p in parts:
+                    writer.write(p)
                 await writer.drain()
             except ConnectionError:
                 pass
@@ -177,6 +178,22 @@ class _Conn:
     def alive(self) -> bool:
         return not self.reader_task.done()
 
+    async def _send_parts(self, parts, timeout: float) -> None:
+        """Part-wise bounded write (see _send): each buffer goes to the
+        transport as-is — large array payloads ride their memoryviews
+        straight from the codec with no event-loop flattening copy."""
+        self.sending += 1
+        try:
+            async with self.write_lock:
+                for p in parts:
+                    self.writer.write(p)
+                await asyncio.wait_for(self.writer.drain(), timeout)
+        except (asyncio.TimeoutError, ConnectionError):
+            self.close()
+            raise
+        finally:
+            self.sending -= 1
+
     async def _send(self, frame: bytes, timeout: float) -> None:
         """Bounded write: a peer that stops draining (full receive buffer,
         long GIL hold) must not wedge the write lock forever — on timeout
@@ -186,19 +203,7 @@ class _Conn:
         `pending`, so without it a broadcast fanning out past the pool cap
         evicts its own conns MID-DRAIN and silently drops frames — at
         N=100 that lost the minted block for every peer beyond the cap."""
-        self.sending += 1
-        try:
-            async with self.write_lock:
-                self.writer.write(frame)
-                await asyncio.wait_for(self.writer.drain(), timeout)
-        except asyncio.TimeoutError:
-            self.close()
-            raise
-        except ConnectionError:
-            self.close()
-            raise
-        finally:
-            self.sending -= 1
+        await self._send_parts([frame], timeout)
 
     async def roundtrip(self, msg_type, meta, arrays, timeout):
         rid = self.next_rid
@@ -207,10 +212,10 @@ class _Conn:
         self.pending[rid] = fut
         meta2 = dict(meta or {})
         meta2["rid"] = rid
-        frame = msgs.encode(msg_type, meta2, arrays)
+        parts = msgs.encode_parts(msg_type, meta2, arrays)
         deadline = asyncio.get_running_loop().time() + timeout
         try:
-            await self._send(frame, timeout)
+            await self._send_parts(parts, timeout)
             remaining = max(0.001, deadline - asyncio.get_running_loop().time())
             return await asyncio.wait_for(fut, remaining)
         finally:

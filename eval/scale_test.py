@@ -203,6 +203,12 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", args.platform)
     jax.config.update("jax_enable_x64", True)
+    # persistent XLA compilation cache: the krum kernel at CNN dims costs
+    # ~30 s to compile, which a 3-5 iteration artifact run would otherwise
+    # charge to the first round's wall clock every single run
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     cfgs = build_cfgs(args)
     key_dir = args.key_dir

@@ -942,38 +942,51 @@ int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
       const fe8 d8 = fe8_splat(consts().d);
       const fe8 one8 = fe8_splat(fe_one());
       const fe8 d2_8 = fe8_splat(D2);
+      // one 8-lane group: unpack + canonical check, 8-wide curve-equation
+      // validation, then fold into the group's accumulator. Returns false
+      // after recording the first bad index.
+      auto do_group = [&](size_t b, size_t g) -> bool {
+        const size_t base = lo + g * 8;
+        fe xs_[8], ys_[8];
+        for (int l = 0; l < 8; l++) {
+          const uint8_t *pb = xy + (b * n + base + l) * 64;
+          if (!canonical_fe_bytes(pb) || !canonical_fe_bytes(pb + 32)) {
+            record_bad(b * n + base + l);
+            return false;
+          }
+          xs_[l] = fe_frombytes(pb);
+          ys_[l] = fe_frombytes(pb + 32);
+        }
+        fe8 x8 = fe8_from_lanes(xs_);
+        fe8 y8 = fe8_from_lanes(ys_);
+        fe8 t8 = fe8_mul(x8, y8);
+        fe8 lhs = fe8_sub(fe8_sq(y8), fe8_sq(x8));
+        fe8 rhs = fe8_add(one8, fe8_mul(d8, fe8_sq(t8)));
+        __mmask8 eq = fe8_eq_mask(lhs, rhs);
+        if (eq != 0xFF) {
+          record_bad(b * n + base + __builtin_ctz((unsigned)(~eq) & 0xFFu));
+          return false;
+        }
+        if (b == 0) {
+          acc8[g] = ge8{x8, y8, one8, t8};
+        } else {
+          nge8 q{fe8_add(y8, x8), fe8_sub(y8, x8), fe8_mul(t8, d2_8)};
+          acc8[g] = ge8_madd(acc8[g], q);
+        }
+        return true;
+      };
       for (size_t b = 0; b < n_batches; b++) {
         if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
-        for (size_t g = 0; g < g8; g++) {
-          const size_t base = lo + g * 8;
-          fe xs_[8], ys_[8];
-          for (int l = 0; l < 8; l++) {
-            const uint8_t *pb = xy + (b * n + base + l) * 64;
-            if (!canonical_fe_bytes(pb) || !canonical_fe_bytes(pb + 32)) {
-              record_bad(b * n + base + l);
-              return;
-            }
-            xs_[l] = fe_frombytes(pb);
-            ys_[l] = fe_frombytes(pb + 32);
-          }
-          fe8 x8 = fe8_from_lanes(xs_);
-          fe8 y8 = fe8_from_lanes(ys_);
-          fe8 t8 = fe8_mul(x8, y8);
-          fe8 lhs = fe8_sub(fe8_sq(y8), fe8_sq(x8));
-          fe8 rhs = fe8_add(one8, fe8_mul(d8, fe8_sq(t8)));
-          __mmask8 eq = fe8_eq_mask(lhs, rhs);
-          if (eq != 0xFF) {
-            record_bad(b * n + base +
-                       __builtin_ctz((unsigned)(~eq) & 0xFFu));
-            return;
-          }
-          if (b == 0) {
-            acc8[g] = ge8{x8, y8, one8, t8};
-          } else {
-            nge8 q{fe8_add(y8, x8), fe8_sub(y8, x8), fe8_mul(t8, d2_8)};
-            acc8[g] = ge8_madd(acc8[g], q);
-          }
+        // pairs of groups: two independent validate+madd chains in
+        // flight (same latency-hiding rationale as the commit path)
+        size_t g = 0;
+        for (; g + 2 <= g8; g += 2) {
+          bool ok0 = do_group(b, g);
+          bool ok1 = do_group(b, g + 1);
+          if (!ok0 || !ok1) return;
         }
+        for (; g < g8; g++)
+          if (!do_group(b, g)) return;
         for (size_t i = lo + g8 * 8; i < hi; i++) {
           fe x, y, t;
           if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t)) {
@@ -1519,63 +1532,124 @@ int batch_commit_core(const uint8_t *a_scalars, const uint8_t *a_signs,
       // the <8 tail fall back to the scalar group below.
       const fe8 one8 = fe8_splat(fe_one());
       const fe8 zero8 = fe8_splat(fe_zero());
-      size_t i0 = lo;
-      for (; i0 + 8 <= hi; i0 += 8) {
-        bool wide = false;
-        for (size_t l = 0; l < 8 && !wide; l++) {
-          const uint8_t *a = a_scalars + (i0 + l) * 32;
-          for (int j = 8; j < 32; j++) wide |= a[j] != 0;
+      // per-window offset/mask builder for one 8-commit group
+      auto h_offs = [&](size_t base, int j, long long *oa, long long *ob,
+                        long long *ot) -> __mmask8 {
+        __mmask8 mask = 0;
+        for (size_t l = 0; l < 8; l++) {
+          const uint8_t *b = b_scalars + (base + l) * 32;
+          uint32_t v = (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
+          if (v) mask |= (uint8_t)(1u << l);
+          long long e =
+              (long long)((size_t)j * 65536 + v) * (long long)sizeof(nge);
+          oa[l] = e;
+          ob[l] = e + 40;
+          ot[l] = e;
         }
-        if (wide) break;  // rare; finish the slice on the scalar path
-        ge8 acc{zero8, one8, one8, zero8};
-        alignas(64) long long offa[8], offb[8], offt[8];
-        if (comb_h) {
-          for (int j = 0; j < 16; j++) {
-            __mmask8 mask = 0;
-            for (size_t l = 0; l < 8; l++) {
-              const uint8_t *b = b_scalars + (i0 + l) * 32;
-              uint32_t v =
-                  (uint32_t)b[2 * j] | ((uint32_t)b[2 * j + 1] << 8);
-              if (v) mask |= (uint8_t)(1u << l);
-              long long e =
-                  (long long)((size_t)j * 65536 + v) * (long long)sizeof(nge);
-              offa[l] = e;
-              offb[l] = e + 40;
-              offt[l] = e;
-            }
-            nge8 q = nge8_gather(comb_h, _mm512_load_si512(offa),
-                                 _mm512_load_si512(offb),
-                                 _mm512_load_si512(offt), mask, 0);
-            acc = ge8_madd(acc, q);
+        return mask;
+      };
+      auto g_offs = [&](size_t base, int j, long long *oa, long long *ob,
+                        long long *ot, __mmask8 &neg) -> __mmask8 {
+        __mmask8 mask = 0;
+        neg = 0;
+        for (size_t l = 0; l < 8; l++) {
+          uint8_t av = a_scalars[(base + l) * 32 + j];
+          bool s = a_signs && a_signs[base + l];
+          if (av) {
+            mask |= (uint8_t)(1u << l);
+            if (s) neg |= (uint8_t)(1u << l);
           }
+          long long e =
+              (long long)((size_t)j * 256 + av) * (long long)sizeof(nge);
+          oa[l] = e + (s ? 40 : 0);
+          ob[l] = e + (s ? 0 : 40);
+          ot[l] = e;
         }
-        for (int j = 0; j < 8; j++) {
-          __mmask8 mask = 0, neg = 0;
-          for (size_t l = 0; l < 8; l++) {
-            uint8_t av = a_scalars[(i0 + l) * 32 + j];
-            bool s = a_signs && a_signs[i0 + l];
-            if (av) {
-              mask |= (uint8_t)(1u << l);
-              if (s) neg |= (uint8_t)(1u << l);
-            }
-            long long e =
-                (long long)((size_t)j * 256 + av) * (long long)sizeof(nge);
-            offa[l] = e + (s ? 40 : 0);
-            offb[l] = e + (s ? 0 : 40);
-            offt[l] = e;
-          }
-          nge8 q = nge8_gather(comb_g, _mm512_load_si512(offa),
-                               _mm512_load_si512(offb),
-                               _mm512_load_si512(offt), mask, neg);
-          acc = ge8_madd(acc, q);
-        }
+        return mask;
+      };
+      auto store_group = [&](size_t base, const ge8 &acc) {
         fe lx[8], ly[8], lz[8], lt[8];
         fe8_to_lanes(acc.X, lx);
         fe8_to_lanes(acc.Y, ly);
         fe8_to_lanes(acc.Z, lz);
         fe8_to_lanes(acc.T, lt);
         for (size_t l = 0; l < 8; l++)
-          res[i0 + l - lo] = ge{lx[l], ly[l], lz[l], lt[l]};
+          res[base + l - lo] = ge{lx[l], ly[l], lz[l], lt[l]};
+      };
+      auto group_wide = [&](size_t base, size_t count) {
+        for (size_t l = 0; l < count; l++) {
+          const uint8_t *a = a_scalars + (base + l) * 32;
+          for (int j = 8; j < 32; j++)
+            if (a[j]) return true;
+        }
+        return false;
+      };
+      alignas(64) long long oa0[8], ob0[8], ot0[8], oa1[8], ob1[8], ot1[8];
+      size_t i0 = lo;
+      // TWO groups (16 commits) advance together: each ge8_madd is a
+      // latency-bound chain of four dependent fe8_mul levels, and the two
+      // groups' independent chains interleave in the out-of-order core
+      // (~1.3× over one group at a time)
+      for (; i0 + 16 <= hi; i0 += 16) {
+        if (group_wide(i0, 16)) break;  // rare; scalar path finishes
+        ge8 acc0{zero8, one8, one8, zero8};
+        ge8 acc1{zero8, one8, one8, zero8};
+        if (comb_h) {
+          for (int j = 0; j < 16; j++) {
+            __mmask8 m0 = h_offs(i0, j, oa0, ob0, ot0);
+            __mmask8 m1 = h_offs(i0 + 8, j, oa1, ob1, ot1);
+            if (!(m0 | m1)) continue;  // short blinds: high windows empty
+            nge8 q0 = nge8_gather(comb_h, _mm512_load_si512(oa0),
+                                  _mm512_load_si512(ob0),
+                                  _mm512_load_si512(ot0), m0, 0);
+            nge8 q1 = nge8_gather(comb_h, _mm512_load_si512(oa1),
+                                  _mm512_load_si512(ob1),
+                                  _mm512_load_si512(ot1), m1, 0);
+            acc0 = ge8_madd(acc0, q0);
+            acc1 = ge8_madd(acc1, q1);
+          }
+        }
+        for (int j = 0; j < 8; j++) {
+          __mmask8 n0, n1;
+          __mmask8 m0 = g_offs(i0, j, oa0, ob0, ot0, n0);
+          __mmask8 m1 = g_offs(i0 + 8, j, oa1, ob1, ot1, n1);
+          if (!(m0 | m1)) continue;  // small magnitudes: high bytes empty
+          nge8 q0 = nge8_gather(comb_g, _mm512_load_si512(oa0),
+                                _mm512_load_si512(ob0),
+                                _mm512_load_si512(ot0), m0, n0);
+          nge8 q1 = nge8_gather(comb_g, _mm512_load_si512(oa1),
+                                _mm512_load_si512(ob1),
+                                _mm512_load_si512(ot1), m1, n1);
+          acc0 = ge8_madd(acc0, q0);
+          acc1 = ge8_madd(acc1, q1);
+        }
+        store_group(i0, acc0);
+        store_group(i0 + 8, acc1);
+      }
+      // single-group pass for the 8..15 remainder
+      for (; i0 + 8 <= hi; i0 += 8) {
+        if (group_wide(i0, 8)) break;
+        ge8 acc{zero8, one8, one8, zero8};
+        if (comb_h) {
+          for (int j = 0; j < 16; j++) {
+            __mmask8 mask = h_offs(i0, j, oa0, ob0, ot0);
+            if (!mask) continue;
+            nge8 q = nge8_gather(comb_h, _mm512_load_si512(oa0),
+                                 _mm512_load_si512(ob0),
+                                 _mm512_load_si512(ot0), mask, 0);
+            acc = ge8_madd(acc, q);
+          }
+        }
+        for (int j = 0; j < 8; j++) {
+          __mmask8 neg;
+          __mmask8 mask = g_offs(i0, j, oa0, ob0, ot0, neg);
+          if (!mask) continue;
+          nge8 q = nge8_gather(comb_g, _mm512_load_si512(oa0),
+                               _mm512_load_si512(ob0),
+                               _mm512_load_si512(ot0), mask, neg);
+          acc = ge8_madd(acc, q);
+        }
+        store_group(i0, acc);
       }
       // scalar finish: the <8 tail, or a group containing a wide scalar
       for (; i0 < hi; i0++) {
