@@ -157,7 +157,17 @@ PALLAS_MAX_N = 4096
 def krum_scores_auto(deltas: jax.Array, num_adversaries: int) -> jax.Array:
     """Dispatch Krum scoring: XLA path for small committees (and for
     n beyond the kernel's VMEM ceiling), the fused Pallas kernel for
-    large ones on TPU."""
+    large ones on TPU.
+
+    Deployment constraint (ADVICE r3): inside the [PALLAS_MIN_N,
+    PALLAS_MAX_N] window the accept set is backend-dependent — Pallas and
+    XLA scores agree only to ~1e-4 rtol, so tie-boundary accept sets can
+    differ between a TPU verifier and a CPU verifier. All verifiers of one
+    cluster must therefore share a backend (see docs/RUNTIME.md,
+    "Verifier backend homogeneity"). The live protocol's committees
+    (3-70 verifiers) sit below PALLAS_MIN_N, where every backend takes
+    the same XLA path, so the constraint binds only for sampled-committee
+    sizes >= 512."""
     from biscotti_tpu.ops.krum import krum_scores
 
     n = deltas.shape[0]

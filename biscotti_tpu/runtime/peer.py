@@ -245,9 +245,12 @@ class PeerAgent:
         # references, so an unreferenced parked task can be GC'd mid-sleep
         self._bg_tasks: Set[asyncio.Task] = set()
         # block hashes whose verifier quorums this peer already
-        # authenticated (_block_quorums_ok memo; sound because
-        # consider_block independently enforces hash == compute_hash)
-        self._quorum_ok_hashes: Set[bytes] = set()
+        # authenticated (_block_quorums_ok memo). Entries are keyed on the
+        # COMPUTED hash of the verified block, never the sender's claimed
+        # hash, so a relabeled genuine block cannot seed the cache for a
+        # forged block that claims the same hash. Insertion-ordered dict =
+        # LRU eviction of the stalest entry.
+        self._quorum_ok_hashes: Dict[bytes, None] = {}
 
     # ------------------------------------------------------------ utilities
 
@@ -823,6 +826,11 @@ class PeerAgent:
         # chain pull otherwise re-pay the whole batched check (measured
         # ~2.3 verifications per peer per block at N=100)
         if blk.hash in self._quorum_ok_hashes:
+            # memo entries are keyed on computed hashes, so a hit proves a
+            # content-identical block (SHA-256 binding) already passed the
+            # batched check; refresh its LRU position
+            self._quorum_ok_hashes.pop(blk.hash)
+            self._quorum_ok_hashes[blk.hash] = None
             return True
         vset = set(self._committee_for(stake_map, prev_hash))
         need = max(1, (len(vset) + 1) // 2)
@@ -844,9 +852,20 @@ class PeerAgent:
                 return False
             items.extend(per_update)
         if cm.batch_schnorr_verify(items):
-            self._quorum_ok_hashes.add(blk.hash)
-            while len(self._quorum_ok_hashes) > 512:
-                self._quorum_ok_hashes.pop()
+            # bind the memo entry to the block CONTENTS: only a block whose
+            # claimed hash IS its computed hash may seed the cache.
+            # Otherwise a Byzantine peer could send the round's genuine
+            # block relabeled with a forged block's hash (quorum verifies,
+            # claimed hash enters the memo, consider_block drops it on the
+            # hash mismatch) and then pass the self-consistent forged block
+            # through the memo without a single signature being checked.
+            if blk.hash == blk.compute_hash():
+                self._quorum_ok_hashes[blk.hash] = None
+                while len(self._quorum_ok_hashes) > 512:
+                    # evict the least-recently-confirmed entry, never the
+                    # one just added (set.pop's arbitrary choice could)
+                    self._quorum_ok_hashes.pop(
+                        next(iter(self._quorum_ok_hashes)))
             return True
         # batch failed: at least one signature is forged — per-item scan
         # would identify it, but for acceptance a single failure damns the

@@ -36,13 +36,18 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="eval/results")
     args = ap.parse_args(argv)
 
+    import shutil
+
     from biscotti_tpu.tools import keygen
 
     key_dir = keygen.make_ephemeral_dir(args.dataset,
                                         2 * args.nodes_per_host)
-    hosts_file = tempfile.mktemp(prefix="biscotti_hosts_", suffix=".txt")
-    with open(hosts_file, "w") as f:
+    hosts_fd, hosts_file = tempfile.mkstemp(prefix="biscotti_hosts_",
+                                            suffix=".txt")
+    with os.fdopen(hosts_fd, "w") as f:
         f.write("localhost\n127.0.0.1\n")
+    peers_fd, peers_file = tempfile.mkstemp(prefix="biscotti_peers_")
+    os.close(peers_fd)
 
     sshim = f"{sys.executable} -m biscotti_tpu.tools.sshim"
     cmd = [sys.executable, "-m", "biscotti_tpu.tools.pod_launch",
@@ -53,13 +58,19 @@ def main(argv=None) -> int:
            "--base-port", str(args.base_port),
            "--secure-agg", "1", "--noising", "1", "--verification", "1",
            "--key-dir", key_dir,
-           "--peers-file", tempfile.mktemp(prefix="biscotti_peers_"),
+           "--peers-file", peers_file,
            "--ssh-cmd", sshim, "--scp-cmd", f"{sshim} --scp"]
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     t0 = time.time()
-    out = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
-                         cwd=REPO, env=env)
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=600, cwd=REPO, env=env)
+    finally:
+        for p in (hosts_file, peers_file):
+            if os.path.exists(p):
+                os.unlink(p)
+        shutil.rmtree(key_dir, ignore_errors=True)
     wall = time.time() - t0
     summary = None
     for line in out.stdout.splitlines():

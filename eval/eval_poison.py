@@ -129,11 +129,17 @@ def main(argv=None) -> int:
     n30 = next(r for r in rows
                if r["poison"] == 0.30 and r["defense"] == "NONE")
     separates = k30["attack_rate"] <= n30["attack_rate"]
+    # ok means exactly "the defense separated" (ADVICE r3: downstream
+    # tooling greps for ok); the exit-code gate is the separately named
+    # gate_passed, which waives only the synthetic-data null result the
+    # separation_note documents
+    gate_passed = separates or not spec.real
     print(json.dumps({"summary": "krum_reduces_attack_rate",
-                      "ok": separates or not spec.real,
+                      "ok": separates,
                       "separates": separates,
+                      "gate_passed": gate_passed,
                       "krum": k30["attack_rate"], "none": n30["attack_rate"]}))
-    return 0 if (separates or not spec.real) else 1
+    return 0 if gate_passed else 1
 
 
 if __name__ == "__main__":

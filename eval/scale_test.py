@@ -134,9 +134,14 @@ async def run_cluster(cfgs, log_dir="", key_dir="", geo_regions=0,
                                      for i, a in enumerate(agents)))
     # wall charges the protocol, not the harness: subtract the launch
     # ramp (last agent starts (N-1)*stagger late; s_per_iter is computed
-    # from round-log timestamps and is unaffected either way)
-    wall = time.time() - t0 - (len(agents) - 1) * stagger_s
-    return agents, results, wall
+    # from round-log timestamps and is unaffected either way). Both the
+    # raw and ramp-adjusted walls are surfaced in the artifact because
+    # early-launched agents do real protocol work during the ramp, so the
+    # adjusted number slightly flatters the wall/n_blocks fallback path
+    # (ADVICE r3).
+    raw_wall = time.time() - t0
+    wall = raw_wall - (len(agents) - 1) * stagger_s
+    return agents, results, wall, raw_wall
 
 
 def main(argv=None) -> int:
@@ -219,7 +224,7 @@ def main(argv=None) -> int:
                                             args.model_name)
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
-    agents, results, wall = asyncio.run(
+    agents, results, wall, raw_wall = asyncio.run(
         run_cluster(cfgs, args.log_dir, key_dir,
                     geo_regions=args.geo_regions,
                     geo_rtt_s=args.geo_rtt_ms / 1000.0,
@@ -263,6 +268,8 @@ def main(argv=None) -> int:
         "geo_rtt_ms": args.geo_rtt_ms if args.geo_regions > 1 else 0,
         "iterations_run": n_blocks, "nonempty_blocks": nonempty,
         "chains_equal": equal, "wall_s": round(wall, 2),
+        "raw_wall_s": round(raw_wall, 2),
+        "launch_ramp_s": round(raw_wall - wall, 2),
         "s_per_iter": round(s_per_iter, 3),
         "final_error": results[0]["final_error"],
         "data_note": (

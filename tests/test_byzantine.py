@@ -21,7 +21,12 @@ from biscotti_tpu.ledger.chain import Blockchain
 from biscotti_tpu.parallel import roles as R
 from biscotti_tpu.runtime.peer import PeerAgent
 
-FAST = Timeouts(update_s=4.0, block_s=20.0, krum_s=4.0, share_s=4.0, rpc_s=6.0)
+# Wide enough that first-compile/warmup contention on a 1-core host cannot
+# push a Byzantine peer's submission past a deadline: a timed-out submission
+# is merely *absent* from the block, not *recorded as rejected*, which is
+# what these tests assert. The honest path finishes long before these fire.
+FAST = Timeouts(update_s=12.0, block_s=40.0, krum_s=12.0, share_s=12.0,
+                rpc_s=15.0)
 
 
 def _cfg(i, n, port, **kw):
@@ -421,3 +426,71 @@ def test_reduced_redundancy_closes_differencing_and_still_converges():
     assert all(d == dumps[0] for d in dumps)
     assert any("ndeltas=" in ln and "ndeltas=0" not in ln
                for ln in dumps[0].splitlines()[1:]), dumps[0]
+
+
+def test_quorum_memo_cannot_be_poisoned_by_relabeled_block():
+    # ATTACK (r4 review finding): a Byzantine peer sends the round's
+    # GENUINE block with its hash field overwritten to equal a forged
+    # block's self-consistent hash. If the quorum memo keyed on the
+    # sender's CLAIMED hash, that relabeled block would verify (the
+    # signatures are genuine), poison the cache with the forged hash, and
+    # the forged block — whose updates carry no signatures at all — would
+    # then pass _block_quorums_ok through the memo. The memo must bind to
+    # block CONTENTS (computed hash), never the claimed hash.
+    import hashlib
+
+    from biscotti_tpu.crypto import commitments as cm
+    from biscotti_tpu.ledger.block import Block, BlockData, Update
+
+    cfg = _cfg(0, 4, 25100, verification=True)
+    agent = PeerAgent(cfg)
+    genesis = agent.chain.blocks[0]
+    vset = agent._committee_for(genesis.stake_map, genesis.hash)
+
+    def make_block(source_id, signed):
+        u = Update(source_id=source_id, iteration=0,
+                   delta=np.zeros(0, np.float64),
+                   commitment=bytes([source_id]) * 32, accepted=True)
+        if signed:
+            msg = agent._sig_message(u.commitment, 0, source_id)
+            for vid in vset:
+                seed = hashlib.sha256(
+                    f"schnorr-{cfg.seed}-{vid}".encode()).digest()
+                u.signers.append(vid)
+                u.signatures.append(cm.schnorr_sign(seed, msg))
+        return Block(
+            data=BlockData(iteration=0,
+                           global_w=np.ones(agent.trainer.num_params),
+                           deltas=[u]),
+            prev_hash=genesis.hash,
+            stake_map=dict(genesis.stake_map)).seal()
+
+    sid = max(i for i in range(4) if i not in vset)
+    genuine = make_block(sid, signed=True)
+    forged = make_block((sid + 1) % 4 if (sid + 1) % 4 not in vset else sid,
+                        signed=False)
+    assert forged.hash == forged.compute_hash()
+
+    # sanity: the forged block fails on a cold cache
+    assert not agent._block_quorums_ok(forged, genesis.stake_map,
+                                       genesis.hash)
+
+    # the poisoning attempt: genuine contents, forged claimed hash
+    relabeled = make_block(sid, signed=True)
+    relabeled.hash = forged.hash
+    assert agent._block_quorums_ok(relabeled, genesis.stake_map,
+                                   genesis.hash), \
+        "genuine signatures must still verify"
+    assert forged.hash not in agent._quorum_ok_hashes, \
+        "claimed hash of a relabeled block entered the quorum memo"
+
+    # the forged block must STILL fail after the poisoning attempt
+    assert not agent._block_quorums_ok(forged, genesis.stake_map,
+                                       genesis.hash), \
+        "forged block passed the signature quorum via a poisoned memo"
+
+    # and an honestly sealed genuine block does memoize (the fast path
+    # the cache exists for)
+    assert agent._block_quorums_ok(genuine, genesis.stake_map,
+                                   genesis.hash)
+    assert genuine.hash in agent._quorum_ok_hashes
