@@ -96,7 +96,7 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         ]
         lib.ed25519_vss_st_accum.restype = ctypes.c_int
         lib.ed25519_vss_st_accum.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_size_t, ctypes.c_size_t, ctypes.c_char_p,
             ctypes.c_char_p,
         ]
@@ -113,6 +113,11 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         lib.ed25519_load_xy_sum.argtypes = [
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
             ctypes.c_char_p,
+        ]
+        lib.ed25519_load_xy_sum_ptrs.restype = ctypes.c_int
+        lib.ed25519_load_xy_sum_ptrs.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_char_p,
         ]
         if not _selfcheck(lib):
             return None
@@ -150,6 +155,25 @@ def available() -> bool:
 
 def _fe_bytes(v: int) -> bytes:
     return (v % ed.P).to_bytes(32, "little")
+
+
+def _buf_addr(obj) -> Tuple[int, int, object]:
+    """(address, byte length, keepalive) for a bytes-like object or a
+    C-contiguous numpy array — zero-copy either way. The keepalive must
+    stay referenced for the duration of the native call: the address
+    points into the object's own storage."""
+    if isinstance(obj, bytes):
+        addr = ctypes.cast(ctypes.c_char_p(obj), ctypes.c_void_p).value
+        return addr or 0, len(obj), obj
+    if isinstance(obj, bytearray):
+        raw = (ctypes.c_char * len(obj)).from_buffer(obj)
+        return ctypes.addressof(raw), len(obj), (obj, raw)
+    # numpy (or anything with the array interface); a non-contiguous view
+    # degrades to one copy rather than corrupt reads
+    import numpy as _np
+
+    arr = _np.ascontiguousarray(obj)
+    return int(arr.ctypes.data), arr.nbytes, arr
 
 
 def point_from_xy64(buf: bytes) -> ed.Point:
@@ -273,20 +297,26 @@ def vss_blind_rows_raw(blinds_buf: bytes, xs: Sequence[int], c_chunks: int,
     return out.raw
 
 
-def vss_st_accum(gammas_buf: bytes, rows_buf: bytes, blinds_buf: bytes,
+def vss_st_accum(gammas_buf: bytes, rows_buf, blinds_buf,
                  s: int, c_chunks: int) -> Optional[Tuple[int, int]]:
     """(Σγ·row, Σγ·t_val) over all S·C cells — the lhs accumulators of the
-    VSS check. Returns None if any blind value is non-canonical (≥ q)."""
+    VSS check. rows_buf/blinds_buf may be bytes or C-contiguous numpy
+    arrays (int64 rows, uint8 blinds) — passed zero-copy. Returns None if
+    any blind value is non-canonical (≥ q)."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
     cells = s * c_chunks
-    if (len(gammas_buf) != 16 * cells or len(rows_buf) != 8 * cells
-            or len(blinds_buf) != 32 * cells):
+    rows_addr, rows_len, keep_r = _buf_addr(rows_buf)
+    blinds_addr, blinds_len, keep_b = _buf_addr(blinds_buf)
+    if (len(gammas_buf) != 16 * cells or rows_len != 8 * cells
+            or blinds_len != 32 * cells):
         raise ValueError("buffer length mismatch")
     out_s = ctypes.create_string_buffer(40)
     out_t = ctypes.create_string_buffer(56)
-    rc = lib.ed25519_vss_st_accum(gammas_buf, rows_buf, blinds_buf,
+    rc = lib.ed25519_vss_st_accum(gammas_buf, ctypes.c_void_p(rows_addr),
+                                  ctypes.c_void_p(blinds_addr),
                                   s, c_chunks, out_s, out_t)
+    del keep_r, keep_b
     if rc != 0:
         return None
     return (int.from_bytes(out_s.raw, "little", signed=True),
@@ -303,6 +333,36 @@ def load_xy_sum(xy: bytes, n_batches: int, n: int) -> Optional[bytes]:
         raise ValueError("xy buffer length mismatch")
     out = ctypes.create_string_buffer(128 * n)
     rc = lib.ed25519_load_xy_sum(xy, n_batches, n, out)
+    if rc != 0:
+        return None
+    return out.raw
+
+
+def load_xy_sum_ptrs(batches: Sequence, n: int) -> Optional[bytes]:
+    """load_xy_sum over SEPARATE per-batch buffers (bytes or C-contiguous
+    numpy arrays of n×64 bytes each) — no concatenation copy. The miner's
+    round intake hands each worker's commitment grid straight from its
+    numpy storage; at CNN dims the contiguous form's join alone copies
+    hundreds of MB. None if any point is non-canonical or off-curve."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    n_batches = len(batches)
+    if n_batches == 0 or n == 0:
+        # mirror the native core's rc=1 on degenerate input (and the old
+        # contiguous path, which returned None here): callers treat None
+        # as "reject", never as an exception
+        return None
+    ptrs = (ctypes.c_void_p * n_batches)()
+    keep = []
+    for i, b in enumerate(batches):
+        addr, nbytes, ka = _buf_addr(b)
+        if nbytes != 64 * n:
+            raise ValueError("batch buffer length mismatch")
+        ptrs[i] = addr
+        keep.append(ka)
+    out = ctypes.create_string_buffer(128 * n)
+    rc = lib.ed25519_load_xy_sum_ptrs(ptrs, n_batches, n, out)
+    del keep
     if rc != 0:
         return None
     return out.raw

@@ -688,8 +688,13 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
         total_cells += len(xs) * c_chunks
     if total_cells == 0:
         return True
-    entropy = entropy if entropy is not None else _os.urandom(16 * total_cells)
-    if len(entropy) < 16 * total_cells:
+    # caller-provided entropy keeps the documented per-instance windows
+    # (tests drive determinism through it); the default draws one window
+    # per GROUP instead — groups only ever consume their first member's
+    # window, so the per-instance allocation was W× oversized (46 MB of
+    # urandom per mnist_cnn intake, all but 1.3 MB discarded)
+    entropy_provided = entropy is not None
+    if entropy_provided and len(entropy) < 16 * total_cells:
         return False
 
     native = _native_mod()
@@ -719,31 +724,38 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
         # gamma_i = entropy 16-byte window with the low bit forced — as an
         # int for the python s/t accumulation, and verbatim as the packed
         # (lo u64, hi u64) little-endian pair the native RLC consumes
-        g0 = members[0][1]
-        gam_bytes = bytearray(entropy[16 * g0: 16 * (g0 + cells)])
+        if entropy_provided:
+            g0 = members[0][1]
+            gam_bytes = bytearray(entropy[16 * g0: 16 * (g0 + cells)])
+        else:
+            gam_bytes = bytearray(_os.urandom(16 * cells))
         for i in range(0, len(gam_bytes), 16):
             gam_bytes[i] |= 1
         gam_bytes = bytes(gam_bytes)
 
         loaded: List = []
         for (comms, _xs, rows, blind_rows), _o in members:
-            comm_bytes = np.ascontiguousarray(comms).tobytes()
-            rows = np.asarray(rows)
-            blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
             if native is not None:
-                loaded.append(comm_bytes)
-                # fused native path: lhs accumulators run per member with
-                # the SHARED γ (linearity makes Σ_w γ·s^w ≡ γ·Σ_w s^w);
-                # zero python bignum traffic on the verify hot path
+                # fused native path, ZERO-COPY: commitment grids, share
+                # rows and blind rows pass as numpy storage pointers (at
+                # CNN dims the former tobytes()/join staging copied
+                # ~0.7 GB per intake). lhs accumulators run per member
+                # with the SHARED γ (linearity makes Σ_w γ·s^w ≡
+                # γ·Σ_w s^w); zero python bignum traffic either
+                loaded.append(np.ascontiguousarray(comms))
                 st_acc = native.vss_st_accum(
                     gam_bytes,
-                    np.ascontiguousarray(rows, dtype=np.int64).tobytes(),
-                    blind_bytes, len(xs), c_chunks)
+                    np.ascontiguousarray(rows, dtype=np.int64),
+                    np.ascontiguousarray(blind_rows),
+                    len(xs), c_chunks)
                 if st_acc is None:
                     return False  # non-canonical blind value
                 s_tot += st_acc[0]
                 t_tot += st_acc[1]
             else:
+                comm_bytes = np.ascontiguousarray(comms).tobytes()
+                rows = np.asarray(rows)
+                blind_bytes = np.ascontiguousarray(blind_rows).tobytes()
                 pts: List[ed.Point] = []
                 for i in range(c_chunks * k):
                     p = _xy_to_point(comm_bytes[64 * i: 64 * i + 64])
@@ -774,9 +786,9 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
             sb, sgn = native.vss_rlc_scalars(xs, gam_bytes, c_chunks, k)
             native_bufs.append((sb, sgn))
             # ONE fused validate+sum pass over the whole group's affine
-            # commitments — no intermediate 128B extended batches
-            buf = native.load_xy_sum(b"".join(loaded), len(loaded),
-                                     c_chunks * k)
+            # commitments, handed over as per-member buffer pointers —
+            # no intermediate 128B extended batches, no concatenation
+            buf = native.load_xy_sum_ptrs(loaded, c_chunks * k)
             if buf is None:
                 return False
             sum_bufs.append(buf)

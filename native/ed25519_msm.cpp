@@ -460,11 +460,19 @@ inline bool ifma_enabled() {
   return on;
 }
 
+// 19·x as shifts+adds: vpmullq (_mm512_mullo_epi64) decodes to 3 µops
+// with ~multi-cycle latency on every IFMA-bearing core, while the three
+// shifts/adds are single-µop port-0/5 ops — measurably faster in the
+// carry/fold hot path
+inline __m512i m512_mul19(__m512i x) {
+  return _mm512_add_epi64(
+      _mm512_add_epi64(_mm512_slli_epi64(x, 4), _mm512_slli_epi64(x, 1)), x);
+}
+
 // carry-normalize: input limbs < 2^63, output limbs ≤ 2^51 + 2^13 (valid
 // madd52 operand, < 2^52) — mirrors scalar fe_carry exactly
 inline fe8 fe8_carry(fe8 a) {
   const __m512i mask = m512_set1(MASK51);
-  const __m512i n19 = m512_set1(19);
   __m512i c;
   for (int i = 0; i < 4; i++) {
     c = _mm512_srli_epi64(a.v[i], 51);
@@ -473,7 +481,7 @@ inline fe8 fe8_carry(fe8 a) {
   }
   c = _mm512_srli_epi64(a.v[4], 51);
   a.v[4] = _mm512_and_epi64(a.v[4], mask);
-  a.v[0] = _mm512_add_epi64(a.v[0], _mm512_mullo_epi64(c, n19));
+  a.v[0] = _mm512_add_epi64(a.v[0], m512_mul19(c));
   c = _mm512_srli_epi64(a.v[0], 51);
   a.v[0] = _mm512_and_epi64(a.v[0], mask);
   a.v[1] = _mm512_add_epi64(a.v[1], c);
@@ -523,10 +531,9 @@ inline fe8 fe8_mul(const fe8 &a, const fe8 &b) {
     t[k] = _mm512_add_epi64(lo[k], _mm512_add_epi64(hi[k], hi[k]));
   t[9] = _mm512_add_epi64(hi[9], hi[9]);
   // fold: value ≡ Σ_{k<5} (t[k] + 19·t[k+5])·2^51k; 19·2^56 < 2^61
-  const __m512i n19 = m512_set1(19);
   fe8 r;
   for (int k = 0; k < 5; k++)
-    r.v[k] = _mm512_add_epi64(t[k], _mm512_mullo_epi64(t[k + 5], n19));
+    r.v[k] = _mm512_add_epi64(t[k], m512_mul19(t[k + 5]));
   return fe8_carry(r);
 }
 
@@ -555,6 +562,82 @@ inline fe8 fe8_splat(const fe &a) {
   fe8 r;
   for (int i = 0; i < 5; i++) r.v[i] = m512_set1(a.v[i]);
   return r;
+}
+
+// 8×8 u64 in-register transpose (24 shuffles): rows in, columns out
+inline void transpose8x8_epi64(__m512i r[8]) {
+  __m512i t[8], u[8];
+  t[0] = _mm512_unpacklo_epi64(r[0], r[1]);
+  t[1] = _mm512_unpackhi_epi64(r[0], r[1]);
+  t[2] = _mm512_unpacklo_epi64(r[2], r[3]);
+  t[3] = _mm512_unpackhi_epi64(r[2], r[3]);
+  t[4] = _mm512_unpacklo_epi64(r[4], r[5]);
+  t[5] = _mm512_unpackhi_epi64(r[4], r[5]);
+  t[6] = _mm512_unpacklo_epi64(r[6], r[7]);
+  t[7] = _mm512_unpackhi_epi64(r[6], r[7]);
+  u[0] = _mm512_shuffle_i64x2(t[0], t[2], 0x88);
+  u[1] = _mm512_shuffle_i64x2(t[1], t[3], 0x88);
+  u[2] = _mm512_shuffle_i64x2(t[0], t[2], 0xDD);
+  u[3] = _mm512_shuffle_i64x2(t[1], t[3], 0xDD);
+  u[4] = _mm512_shuffle_i64x2(t[4], t[6], 0x88);
+  u[5] = _mm512_shuffle_i64x2(t[5], t[7], 0x88);
+  u[6] = _mm512_shuffle_i64x2(t[4], t[6], 0xDD);
+  u[7] = _mm512_shuffle_i64x2(t[5], t[7], 0xDD);
+  r[0] = _mm512_shuffle_i64x2(u[0], u[4], 0x88);
+  r[4] = _mm512_shuffle_i64x2(u[0], u[4], 0xDD);
+  r[1] = _mm512_shuffle_i64x2(u[1], u[5], 0x88);
+  r[5] = _mm512_shuffle_i64x2(u[1], u[5], 0xDD);
+  r[2] = _mm512_shuffle_i64x2(u[2], u[6], 0x88);
+  r[6] = _mm512_shuffle_i64x2(u[2], u[6], 0xDD);
+  r[3] = _mm512_shuffle_i64x2(u[3], u[7], 0x88);
+  r[7] = _mm512_shuffle_i64x2(u[3], u[7], 0xDD);
+}
+
+// Load + canonicality-check + radix-51 split of 8 CONSECUTIVE 64-byte
+// affine (x, y) pairs, fully in-vector: one 64-byte load per point, an
+// 8×8 u64 transpose, then the limb split as shifts/masks. Replaces the
+// scalar byte-loop fe_frombytes ×16 + store/reload lane transpose, which
+// profiled at ~70% of the fused validate+sum kernel. `ok` has a bit per
+// point (x AND y canonical, i.e. < p) — limb values for non-canonical
+// lanes are still produced but must be discarded by the caller.
+inline void fe8_load_xy8(const uint8_t *pb0, fe8 &x8, fe8 &y8,
+                         __mmask8 &ok) {
+  __m512i r[8];
+  for (int l = 0; l < 8; l++)
+    r[l] = _mm512_loadu_si512((const void *)(pb0 + l * 64));
+  transpose8x8_epi64(r);
+  // r[0..3] = x words, r[4..7] = y words (word j of all 8 points)
+  const __m512i mask = m512_set1(MASK51);
+  auto split = [&](const __m512i w[4]) {
+    fe8 f;
+    f.v[0] = _mm512_and_epi64(w[0], mask);
+    f.v[1] = _mm512_and_epi64(
+        _mm512_or_epi64(_mm512_srli_epi64(w[0], 51),
+                        _mm512_slli_epi64(w[1], 13)), mask);
+    f.v[2] = _mm512_and_epi64(
+        _mm512_or_epi64(_mm512_srli_epi64(w[1], 38),
+                        _mm512_slli_epi64(w[2], 26)), mask);
+    f.v[3] = _mm512_and_epi64(
+        _mm512_or_epi64(_mm512_srli_epi64(w[2], 25),
+                        _mm512_slli_epi64(w[3], 39)), mask);
+    f.v[4] = _mm512_and_epi64(_mm512_srli_epi64(w[3], 12), mask);
+    return f;
+  };
+  // vector form of canonical_fe_bytes (value < p), lane-parallel
+  const __m512i top = m512_set1(0x7FFFFFFFFFFFFFFFULL);
+  const __m512i ones = m512_set1(~0ULL);
+  const __m512i low = m512_set1(0xFFFFFFFFFFFFFFEDULL);
+  auto canonical = [&](const __m512i w[4]) -> __mmask8 {
+    __mmask8 lt = _mm512_cmplt_epu64_mask(w[3], top);
+    __mmask8 eqt = _mm512_cmpeq_epu64_mask(w[3], top);
+    __mmask8 mid = _mm512_cmpneq_epu64_mask(
+        _mm512_and_epi64(w[2], w[1]), ones);
+    __mmask8 lo = _mm512_cmplt_epu64_mask(w[0], low);
+    return lt | (__mmask8)(eqt & (__mmask8)(mid | lo));
+  };
+  ok = (__mmask8)(canonical(r) & canonical(r + 4));
+  x8 = split(r);
+  y8 = split(r + 4);
 }
 
 // per-lane equality mod p: freeze both to canonical limbs (carry twice +
@@ -904,26 +987,27 @@ int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out) {
   return 0;
 }
 
-// Fused affine-load + pointwise-sum: B batches of n×64B affine (x,y)
-// pairs → ONE n×128B extended batch, out[i] = Σ_b in[b·n + i]. Each point
-// is validated exactly like ed25519_load_xy_batch (canonical, on-curve;
-// subgroup left to the callers' cofactored scalars), but the intermediate
-// 128B serialize/re-parse round trip of load-then-sum is gone and the
-// accumulation runs as 7-mul mixed additions against the affine input
-// (whose x·y product the on-curve check already computed). Returns 0, or
-// 1 + flat index (b·n + i) of the first invalid point so the caller can
-// attribute the bad batch.
-int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
-                        uint8_t *out) {
+// Fused affine-load + pointwise-sum over B SEPARATE batch buffers of
+// n×64B affine (x,y) pairs → ONE n×128B extended batch,
+// out[i] = Σ_b batch_b[i]. Each point is validated exactly like
+// ed25519_load_xy_batch (canonical, on-curve; subgroup left to the
+// callers' cofactored scalars); the accumulation runs as 7-mul mixed
+// additions against the affine input (whose x·y product the on-curve
+// check already computed). Returns 0, or 1 + flat index (b·n + i) of an
+// invalid point (the minimum among those each slice saw first — callers
+// treat any nonzero rc as "reject the whole batch set").
+//
+// Loop order is POINT-major with the batch loop INNERMOST: the
+// accumulator for a group of points lives in registers/L1 across all B
+// batches and `out` is written exactly once per point. The previous
+// batch-major sweep re-read and re-wrote the whole n×128B accumulator
+// array per batch — at CNN dims (n = 164k points, 26 MB extended) that
+// was ~2·B·26 MB of DRAM traffic and dominated the miner's verify wall
+// clock. Input locality is preserved with explicit next-batch prefetch
+// (B concurrent read streams exceed the hardware tracker budget).
+static int load_xy_sum_core(const uint8_t *const *xyp, size_t n_batches,
+                            size_t n, uint8_t *out) {
   if (n_batches == 0 || n == 0) return 1;
-  // threaded over the point index: each slice owns acc[lo,hi) and sweeps
-  // it batch-major (each pass reads one batch's slice sequentially —
-  // cache-friendly at C·k ≈ 62k points × 64B). On a failed point the
-  // slice records its first bad flat index and stops; the reported index
-  // is the minimum across slices (callers treat any nonzero rc as
-  // "reject the whole batch set", so exact batch-major order of the
-  // reported index does not matter — biscotti_tpu/crypto/_native.py
-  // load_xy_sum discards it).
   std::atomic<size_t> first_bad{SIZE_MAX};
   auto record_bad = [&first_bad](size_t idx) {
     size_t cur = first_bad.load(std::memory_order_relaxed);
@@ -931,129 +1015,151 @@ int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
     }
   };
   parallel_slices(n, 2048, [&](size_t lo, size_t hi) {
+    // scalar one-point chain shared by the IFMA tail and the no-IFMA
+    // path: validate + accumulate point i across all batches, store once
+    auto scalar_point = [&](size_t i) -> bool {
+      fe x, y, t;
+      if (!load_affine_checked(xyp[0] + i * 64, x, y, t)) {
+        record_bad(i);
+        return false;
+      }
+      ge a{x, y, fe_one(), t};
+      for (size_t b = 1; b < n_batches; b++) {
+        if (!load_affine_checked(xyp[b] + i * 64, x, y, t)) {
+          record_bad(b * n + i);
+          return false;
+        }
+        nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
+        a = ge_madd(a, q);
+      }
+      uint8_t *o = out + i * 128;
+      fe_tobytes(o, a.X);
+      fe_tobytes(o + 32, a.Y);
+      fe_tobytes(o + 64, a.Z);
+      fe_tobytes(o + 96, a.T);
+      return true;
+    };
 #ifdef BISCOTTI_IFMA
     if (ifma_enabled()) {
-      // 8 points per step: canonicality stays scalar (u64 compares), the
-      // curve-equation check and the niels accumulation run 8 lanes wide
       const size_t m = hi - lo;
       const size_t g8 = m / 8;  // full vector groups; tail runs scalar
-      std::vector<ge8> acc8(g8);
-      std::vector<ge> acct(m - g8 * 8);
       const fe8 d8 = fe8_splat(consts().d);
       const fe8 one8 = fe8_splat(fe_one());
-      const fe8 d2_8 = fe8_splat(D2);
-      // one 8-lane group: unpack + canonical check, 8-wide curve-equation
-      // validation, then fold into the group's accumulator. Returns false
-      // after recording the first bad index.
-      auto do_group = [&](size_t b, size_t g) -> bool {
-        const size_t base = lo + g * 8;
-        fe xs_[8], ys_[8];
-        for (int l = 0; l < 8; l++) {
-          const uint8_t *pb = xy + (b * n + base + l) * 64;
-          if (!canonical_fe_bytes(pb) || !canonical_fe_bytes(pb + 32)) {
-            record_bad(b * n + base + l);
-            return false;
-          }
-          xs_[l] = fe_frombytes(pb);
-          ys_[l] = fe_frombytes(pb + 32);
+      // unpack + canonical check (scalar u64 compares), 8-wide
+      // curve-equation validation; fills (x8, y8, t8) for the caller
+      // validate one 8-lane group and emit exactly the operands the
+      // accumulate step needs: the curve check is rewritten to share its
+      // products with the madd — lhs y²−x² = (y+x)(y−x) reuses the niels
+      // sums, and t·d serves both the check's d·t² = (t·d)·t and the
+      // madd's T2d = 2·(t·d). 4 fe8 muls per group-batch instead of 6.
+      auto load_group = [&](size_t b, size_t base, fe8 &x8, fe8 &y8,
+                            fe8 &t8, fe8 &yp, fe8 &ym, fe8 &t2d) -> bool {
+        const uint8_t *pb0 = xyp[b] + base * 64;
+        __mmask8 okc;
+        fe8_load_xy8(pb0, x8, y8, okc);
+        if (okc != 0xFF) {
+          record_bad(b * n + base + __builtin_ctz((unsigned)(~okc) & 0xFFu));
+          return false;
         }
-        fe8 x8 = fe8_from_lanes(xs_);
-        fe8 y8 = fe8_from_lanes(ys_);
-        fe8 t8 = fe8_mul(x8, y8);
-        fe8 lhs = fe8_sub(fe8_sq(y8), fe8_sq(x8));
-        fe8 rhs = fe8_add(one8, fe8_mul(d8, fe8_sq(t8)));
+        t8 = fe8_mul(x8, y8);
+        yp = fe8_add(y8, x8);
+        ym = fe8_sub(y8, x8);
+        fe8 lhs = fe8_mul(yp, ym);
+        fe8 v = fe8_mul(t8, d8);
+        fe8 rhs = fe8_add(one8, fe8_mul(v, t8));
+        t2d = fe8_add(v, v);
         __mmask8 eq = fe8_eq_mask(lhs, rhs);
         if (eq != 0xFF) {
           record_bad(b * n + base + __builtin_ctz((unsigned)(~eq) & 0xFFu));
           return false;
         }
-        if (b == 0) {
-          acc8[g] = ge8{x8, y8, one8, t8};
-        } else {
-          nge8 q{fe8_add(y8, x8), fe8_sub(y8, x8), fe8_mul(t8, d2_8)};
-          acc8[g] = ge8_madd(acc8[g], q);
-        }
         return true;
       };
-      for (size_t b = 0; b < n_batches; b++) {
-        if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
-        // pairs of groups: two independent validate+madd chains in
-        // flight (same latency-hiding rationale as the commit path)
-        size_t g = 0;
-        for (; g + 2 <= g8; g += 2) {
-          bool ok0 = do_group(b, g);
-          bool ok1 = do_group(b, g + 1);
-          if (!ok0 || !ok1) return;
-        }
-        for (; g < g8; g++)
-          if (!do_group(b, g)) return;
-        for (size_t i = lo + g8 * 8; i < hi; i++) {
-          fe x, y, t;
-          if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t)) {
-            record_bad(b * n + i);
-            return;
-          }
-          if (b == 0) {
-            acct[i - lo - g8 * 8] = ge{x, y, fe_one(), t};
-          } else {
-            nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
-            acct[i - lo - g8 * 8] = ge_madd(acct[i - lo - g8 * 8], q);
-          }
-        }
-      }
-      for (size_t g = 0; g < g8; g++) {
+      auto store_group = [&](size_t base, const ge8 &a) {
         fe lx[8], ly[8], lz[8], lt[8];
-        fe8_to_lanes(acc8[g].X, lx);
-        fe8_to_lanes(acc8[g].Y, ly);
-        fe8_to_lanes(acc8[g].Z, lz);
-        fe8_to_lanes(acc8[g].T, lt);
+        fe8_to_lanes(a.X, lx);
+        fe8_to_lanes(a.Y, ly);
+        fe8_to_lanes(a.Z, lz);
+        fe8_to_lanes(a.T, lt);
         for (int l = 0; l < 8; l++) {
-          uint8_t *o = out + (lo + g * 8 + l) * 128;
+          uint8_t *o = out + (base + l) * 128;
           fe_tobytes(o, lx[l]);
           fe_tobytes(o + 32, ly[l]);
           fe_tobytes(o + 64, lz[l]);
           fe_tobytes(o + 96, lt[l]);
         }
+      };
+      // pairs of groups: two independent validate+madd chains in flight
+      // hide ge8_madd's serial latency (the batch loop is a dependent
+      // chain per accumulator)
+      size_t g = 0;
+      for (; g + 2 <= g8; g += 2) {
+        if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
+        const size_t base0 = lo + g * 8;
+        const size_t base1 = base0 + 8;
+        fe8 x0, y0, t0, yp0, ym0, td0, x1, y1, t1, yp1, ym1, td1;
+        if (!load_group(0, base0, x0, y0, t0, yp0, ym0, td0)) return;
+        if (!load_group(0, base1, x1, y1, t1, yp1, ym1, td1)) return;
+        ge8 acc0{x0, y0, one8, t0};
+        ge8 acc1{x1, y1, one8, t1};
+        for (size_t b = 1; b < n_batches; b++) {
+          if (b + 1 < n_batches) {
+            // 16 points (2 groups) = 16 cache lines for the next batch
+            const char *nx =
+                reinterpret_cast<const char *>(xyp[b + 1] + base0 * 64);
+            for (int l = 0; l < 16; l++)
+              _mm_prefetch(nx + l * 64, _MM_HINT_T0);
+          }
+          if (!load_group(b, base0, x0, y0, t0, yp0, ym0, td0)) return;
+          acc0 = ge8_madd(acc0, nge8{yp0, ym0, td0});
+          if (!load_group(b, base1, x1, y1, t1, yp1, ym1, td1)) return;
+          acc1 = ge8_madd(acc1, nge8{yp1, ym1, td1});
+        }
+        store_group(base0, acc0);
+        store_group(base1, acc1);
       }
-      for (size_t i = lo + g8 * 8; i < hi; i++) {
-        uint8_t *o = out + i * 128;
-        const ge &a = acct[i - lo - g8 * 8];
-        fe_tobytes(o, a.X);
-        fe_tobytes(o + 32, a.Y);
-        fe_tobytes(o + 64, a.Z);
-        fe_tobytes(o + 96, a.T);
+      for (; g < g8; g++) {
+        if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
+        const size_t base = lo + g * 8;
+        fe8 x8, y8, t8, yp, ym, td;
+        if (!load_group(0, base, x8, y8, t8, yp, ym, td)) return;
+        ge8 acc{x8, y8, one8, t8};
+        for (size_t b = 1; b < n_batches; b++) {
+          if (!load_group(b, base, x8, y8, t8, yp, ym, td)) return;
+          acc = ge8_madd(acc, nge8{yp, ym, td});
+        }
+        store_group(base, acc);
       }
+      for (size_t i = lo + g8 * 8; i < hi; i++)
+        if (!scalar_point(i)) return;
       return;
     }
 #endif
-    std::vector<ge> acc(hi - lo);
-    for (size_t b = 0; b < n_batches; b++) {
-      if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
-      for (size_t i = lo; i < hi; i++) {
-        fe x, y, t;
-        if (!load_affine_checked(xy + (b * n + i) * 64, x, y, t)) {
-          record_bad(b * n + i);
-          return;
-        }
-        if (b == 0) {
-          acc[i - lo] = ge{x, y, fe_one(), t};
-        } else {
-          nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
-          acc[i - lo] = ge_madd(acc[i - lo], q);
-        }
-      }
-    }
     for (size_t i = lo; i < hi; i++) {
-      uint8_t *o = out + i * 128;
-      fe_tobytes(o, acc[i - lo].X);
-      fe_tobytes(o + 32, acc[i - lo].Y);
-      fe_tobytes(o + 64, acc[i - lo].Z);
-      fe_tobytes(o + 96, acc[i - lo].T);
+      if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
+      if (!scalar_point(i)) return;
     }
   });
   size_t bad = first_bad.load();
   if (bad != SIZE_MAX) return (int)(bad + 1);
   return 0;
+}
+
+// Contiguous-buffer form (batch b at xy + b·n·64).
+int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
+                        uint8_t *out) {
+  if (n_batches == 0 || n == 0) return 1;
+  std::vector<const uint8_t *> ptrs(n_batches);
+  for (size_t b = 0; b < n_batches; b++) ptrs[b] = xy + b * n * 64;
+  return load_xy_sum_core(ptrs.data(), n_batches, n, out);
+}
+
+// Scattered-buffer form: one pointer per batch — callers hand their
+// workers' commitment grids directly (numpy buffers), skipping the
+// B·n·64-byte concatenation copy the contiguous form forces on Python.
+int ed25519_load_xy_sum_ptrs(const uint8_t *const *batches,
+                             size_t n_batches, size_t n, uint8_t *out) {
+  return load_xy_sum_core(batches, n_batches, n, out);
 }
 
 // Batch point decompression, RFC 8032 rules (mirrors the pure-python
@@ -1367,8 +1473,17 @@ int ed25519_vss_st_accum(const uint64_t *gammas, const int64_t *rows,
   std::mutex merge_mu;
   std::atomic<size_t> first_bad{SIZE_MAX};
   parallel_slices(cells, 65536, [&](size_t lo, size_t hi) {
-    uint64_t sl_s[5] = {0, 0, 0, 0, 0};
-    uint64_t sl_t[7] = {0, 0, 0, 0, 0, 0, 0};
+    // COLUMN accumulators: one signed 128-bit sum per 64-bit limb
+    // position, fed the raw product halves with NO per-cell carry
+    // propagation (acc_add_at's data-dependent ripple loop per product
+    // dominated this kernel). Overflow-safe: each column absorbs at most
+    // 2·(hi−lo) terms of < 2^64 — any slice below 2^62 cells stays
+    // within the signed-128 range (real intakes are ≤ 2^23 cells).
+    // Value identity: total = Σ_c col[c]·2^(64c); the merge below
+    // re-expresses that in the fixed-width two's-complement limbs, which
+    // per-slice-partials sum to the exact serial total.
+    __int128 col_s[5] = {0, 0, 0, 0, 0};
+    unsigned __int128 col_t[7] = {0, 0, 0, 0, 0, 0, 0};
     for (size_t i = lo; i < hi; i++) {
       uint64_t g[2] = {gammas[2 * i], gammas[2 * i + 1]};
       // s: γ · row (signed)
@@ -1377,11 +1492,11 @@ int ed25519_vss_st_accum(const uint64_t *gammas, const int64_t *rows,
       for (int gl = 0; gl < 2; gl++) {
         unsigned __int128 p = (unsigned __int128)g[gl] * m;
         if (r < 0) {
-          acc_sub_at(sl_s, 5, gl, (uint64_t)p);
-          acc_sub_at(sl_s, 5, gl + 1, (uint64_t)(p >> 64));
+          col_s[gl] -= (uint64_t)p;
+          col_s[gl + 1] -= (uint64_t)(p >> 64);
         } else {
-          acc_add_at(sl_s, 5, gl, (uint64_t)p);
-          acc_add_at(sl_s, 5, gl + 1, (uint64_t)(p >> 64));
+          col_s[gl] += (uint64_t)p;
+          col_s[gl + 1] += (uint64_t)(p >> 64);
         }
       }
       // t: γ · t_val (both non-negative); t_val must be canonical (< q)
@@ -1401,23 +1516,30 @@ int ed25519_vss_st_accum(const uint64_t *gammas, const int64_t *rows,
       for (int gl = 0; gl < 2; gl++) {
         for (int tl = 0; tl < 4; tl++) {
           unsigned __int128 p = (unsigned __int128)g[gl] * t[tl];
-          acc_add_at(sl_t, 7, gl + tl, (uint64_t)p);
-          acc_add_at(sl_t, 7, gl + tl + 1, (uint64_t)(p >> 64));
+          col_t[gl + tl] += (uint64_t)p;
+          col_t[gl + tl + 1] += (uint64_t)(p >> 64);
         }
       }
     }
     std::lock_guard<std::mutex> lk(merge_mu);
-    uint64_t c = 0;
-    for (int l = 0; l < 5; l++) {
-      unsigned __int128 v = (unsigned __int128)s_acc[l] + sl_s[l] + c;
-      s_acc[l] = (uint64_t)v;
-      c = (uint64_t)(v >> 64);
+    // fold the signed columns into the fixed-width accumulators:
+    // column c contributes sign·|col|·2^(64c) (two's-complement wrap on
+    // the fixed width, exactly like the old per-product path)
+    for (int c = 0; c < 5; c++) {
+      __int128 v = col_s[c];
+      unsigned __int128 mag =
+          v < 0 ? (unsigned __int128)(-v) : (unsigned __int128)v;
+      if (v < 0) {
+        acc_sub_at(s_acc, 5, c, (uint64_t)mag);
+        if (c + 1 < 5) acc_sub_at(s_acc, 5, c + 1, (uint64_t)(mag >> 64));
+      } else {
+        acc_add_at(s_acc, 5, c, (uint64_t)mag);
+        if (c + 1 < 5) acc_add_at(s_acc, 5, c + 1, (uint64_t)(mag >> 64));
+      }
     }
-    c = 0;
-    for (int l = 0; l < 7; l++) {
-      unsigned __int128 v = (unsigned __int128)t_acc[l] + sl_t[l] + c;
-      t_acc[l] = (uint64_t)v;
-      c = (uint64_t)(v >> 64);
+    for (int c = 0; c < 7; c++) {
+      acc_add_at(t_acc, 7, c, (uint64_t)col_t[c]);
+      if (c + 1 < 7) acc_add_at(t_acc, 7, c + 1, (uint64_t)(col_t[c] >> 64));
     }
   });
   size_t bad = first_bad.load();

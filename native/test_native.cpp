@@ -32,6 +32,8 @@ int ed25519_batch_commit_signed(const uint8_t *a_mags, const uint8_t *a_signs,
 int ed25519_load_xy_batch(const uint8_t *xy, size_t n, uint8_t *out);
 int ed25519_load_xy_sum(const uint8_t *xy, size_t n_batches, size_t n,
                         uint8_t *out);
+int ed25519_load_xy_sum_ptrs(const uint8_t *const *batches, size_t n_batches,
+                             size_t n, uint8_t *out);
 int ed25519_vss_rlc_scalars(const int64_t *xs, const uint64_t *gammas,
                             size_t S, size_t C, size_t k,
                             uint8_t *out_scalars, uint8_t *out_signs);
@@ -158,10 +160,21 @@ void test_load_xy_sum() {
     check(memcmp(aff, expect.data() + i * 64, 64) == 0,
           "load_xy_sum == comb sum");
   }
+  // the scattered-pointer form must agree with the contiguous form —
+  // including with batches handed over in a DIFFERENT memory order
+  std::vector<uint8_t> summed_p(n * 128);
+  const uint8_t *ptrs[3] = {batches.data(), batches.data() + n * 64,
+                            batches.data() + 2 * n * 64};
+  check(ed25519_load_xy_sum_ptrs(ptrs, 3, n, summed_p.data()) == 0,
+        "load_xy_sum_ptrs runs");
+  check(memcmp(summed.data(), summed_p.data(), n * 128) == 0,
+        "ptrs form == contiguous form");
   // corruption in the middle of batch 2, lane 3 of a vector group
   batches[(2 * n + 11) * 64 + 5] ^= 0x40;
   check(ed25519_load_xy_sum(batches.data(), 3, n, summed.data()) != 0,
         "corrupted point rejected");
+  check(ed25519_load_xy_sum_ptrs(ptrs, 3, n, summed_p.data()) != 0,
+        "ptrs form rejects corruption");
 }
 
 // Differential check of the grouped commit path (8-lane gathered combs on

@@ -376,3 +376,18 @@ def test_native_library_loads_when_toolchain_present():
     assert _native.available(), (
         "native build/load failed despite a toolchain being present — "
         "check `make -C native` output")
+
+
+def test_verify_multi_zero_width_grid_rejects_not_raises():
+    # library contract: vss_verify_multi returns bool on ANY input shape
+    # that passes its own validation — a degenerate zero-width commitment
+    # grid (k == 0) must reject identically on the native and python
+    # paths, not raise out of the native wrapper (r4 review finding)
+    import numpy as np
+
+    from biscotti_tpu.crypto import commitments as cmx
+
+    comms = np.zeros((4, 0, 64), dtype=np.uint8)
+    rows = np.zeros((2, 4), dtype=np.int64)
+    br = np.zeros((2, 4, 32), dtype=np.uint8)
+    assert cmx.vss_verify_multi([(comms, [1, 2], rows, br)]) is False
