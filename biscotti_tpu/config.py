@@ -104,6 +104,12 @@ class BiscottiConfig:
     poison_fraction: float = 0.0
     colluders: int = 0
     dp_in_model: bool = False  # DP_IN_MODEL mode (ref: main.go:155,860-864)
+    # DP mechanism: "gaussian" = Abadi-16 presampled Gaussian (the
+    # reference's default path, client_obj.py:59-67); "mcmc13" = the
+    # Song&Sarwate'13 MCMC draw from exp(−ε/2·‖x‖) (the reference's
+    # diffPriv13 branch, client_obj.py:44-57 — emcee there, a vectorized
+    # Metropolis ensemble under lax.scan here, ops/dp_noise.py)
+    dp_mechanism: str = "gaussian"
 
     # --- sampling (ref flags -ns -rs, main.go:645,649) ---
     sample_percent: float = 0.70  # NUM_SAMPLES = 70% of contributors
@@ -247,11 +253,20 @@ class BiscottiConfig:
         p.add_argument("-np", "--noising", type=int, default=1)
         p.add_argument("-vp", "--verification", type=int, default=1)
         p.add_argument("-ep", "--epsilon", type=float, default=1.0)
+        p.add_argument("--dp-mechanism", type=str, default="gaussian",
+                       choices=["gaussian", "mcmc13"],
+                       help="gaussian = Abadi-16 presample (ref default); "
+                            "mcmc13 = Song&Sarwate'13 MCMC "
+                            "(ref diffPriv13 branch)")
         p.add_argument("-po", "--poison-fraction", type=float, default=0.0)
         p.add_argument("-ns", "--sample-percent", type=float, default=70.0)
         p.add_argument("-rs", "--random-sampling", type=int, default=0)
         p.add_argument("--defense", type=str, default="KRUM", choices=[d.value for d in Defense])
         p.add_argument("--max-iterations", type=int, default=100)
+        p.add_argument("--convergence-error", type=float, default=0.05,
+                       help="train-error exit threshold (ref main.go:1067-"
+                            "1094); 0 disables early exit so fault "
+                            "harnesses control run length exactly")
         p.add_argument("--fail-prob", type=float, default=0.0)
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--fedsys", type=int, default=0,
@@ -279,11 +294,13 @@ class BiscottiConfig:
             noising=bool(ns.noising),
             verification=bool(ns.verification),
             epsilon=ns.epsilon,
+            dp_mechanism=getattr(ns, "dp_mechanism", "gaussian"),
             poison_fraction=ns.poison_fraction,
             sample_percent=sample,
             random_sampling=bool(ns.random_sampling),
             defense=Defense(ns.defense),
             max_iterations=ns.max_iterations,
+            convergence_error=getattr(ns, "convergence_error", 0.05),
             fail_prob=ns.fail_prob,
             seed=ns.seed,
             fedsys=bool(getattr(ns, "fedsys", 0)),

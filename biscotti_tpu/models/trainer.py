@@ -145,12 +145,21 @@ class Trainer:
         self.num_params = self.model.num_params
         base = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.seed)
         noise_key, batch_key = jax.random.split(base)
-        self.noise_samples = dp_noise.presample(
-            noise_key,
-            self.cfg.epsilon if self.cfg.noising or self.cfg.dp_in_model else 0.0,
-            self.cfg.delta, self.batch_size, self.cfg.noise_presample_iters,
-            self.num_params,
-        )
+        eps_live = (self.cfg.epsilon
+                    if self.cfg.noising or self.cfg.dp_in_model else 0.0)
+        self.noise_accept_rate = None
+        if self.cfg.dp_mechanism == "mcmc13":
+            # Song&Sarwate'13 branch (ref: client_obj.py:44-57); served
+            # through the same noise_at/get_noise surface as the Gaussian
+            self.noise_samples, acc = dp_noise.mcmc_presample(
+                noise_key, eps_live, self.cfg.noise_presample_iters,
+                self.num_params)
+            self.noise_accept_rate = float(acc) if eps_live > 0 else None
+        else:
+            self.noise_samples = dp_noise.presample(
+                noise_key, eps_live, self.cfg.delta, self.batch_size,
+                self.cfg.noise_presample_iters, self.num_params,
+            )
 
         alpha = self.cfg.logreg_alpha
         self._batch_key = batch_key
@@ -205,9 +214,8 @@ class Trainer:
         """Stricter 1→7 metric: fraction of attack-source samples predicted
         as exactly the attack target class (not inflated by benign
         confusion the way `attack_rate` can be)."""
-        from biscotti_tpu.data.datasets import DATASETS
 
-        target = DATASETS[self.dataset].attack_target
+        target = ds.spec(self.dataset).attack_target
         logits = self.model.apply_flat(jnp.asarray(flat_w, jnp.float32),
                                        self.x_attack)
         pred = jnp.argmax(logits, axis=-1)

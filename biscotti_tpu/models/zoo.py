@@ -19,7 +19,7 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from biscotti_tpu.data.datasets import DATASETS
+from biscotti_tpu.data.datasets import base_name, spec as dspec
 from biscotti_tpu.models.base import Model, cross_entropy, make_model, multiclass_hinge
 
 
@@ -194,9 +194,9 @@ def lfw_cnn_model() -> Model:
 
 
 MODELS: Dict[str, callable] = {
-    "softmax": lambda ds: softmax_model(DATASETS[ds].d_in, DATASETS[ds].n_classes),
-    "logreg": lambda ds: logreg_model(DATASETS[ds].d_in),
-    "svm": lambda ds: svm_model(DATASETS[ds].d_in, DATASETS[ds].n_classes),
+    "softmax": lambda ds: softmax_model(dspec(ds).d_in, dspec(ds).n_classes),
+    "logreg": lambda ds: logreg_model(dspec(ds).d_in),
+    "svm": lambda ds: svm_model(dspec(ds).d_in, dspec(ds).n_classes),
     "mnist_cnn": lambda ds: mnist_cnn_model(),
     "cifar_cnn": lambda ds: cifar_cnn_model(),
     "lfw_cnn": lambda ds: lfw_cnn_model(),
@@ -209,6 +209,6 @@ def model_for_dataset(dataset: str, model: str = "") -> Model:
     via ML/code/logistic_model.py)."""
     if model:
         return MODELS[model](dataset)
-    if dataset == "creditcard":
-        return logreg_model(DATASETS[dataset].d_in)
-    return softmax_model(DATASETS[dataset].d_in, DATASETS[dataset].n_classes)
+    if base_name(dataset) == "creditcard":
+        return logreg_model(dspec(dataset).d_in)
+    return softmax_model(dspec(dataset).d_in, dspec(dataset).n_classes)

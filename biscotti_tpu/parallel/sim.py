@@ -126,9 +126,10 @@ class Simulator:
         alpha = cfg.logreg_alpha
         self._step = local_step_fn(self.model, self.mode, clip=cfg.grad_clip,
                                    alpha=alpha)
-        self._noise_scale = dp_noise.sigma_for(
-            cfg.epsilon if cfg.noising or cfg.dp_in_model else 0.0, cfg.delta
-        )
+        self._noise_eps = (cfg.epsilon
+                           if cfg.noising or cfg.dp_in_model else 0.0)
+        self._noise_scale = dp_noise.sigma_for(self._noise_eps, cfg.delta)
+        self._dp_mechanism = cfg.dp_mechanism
         self._noise_alpha = alpha if self.mode == "sgd" else 1.0
         self._round_step_raw = self._build_round_step()
         self._round_step_jit = jax.jit(self._round_step_raw,
@@ -158,9 +159,16 @@ class Simulator:
         client_obj.py:59-67,97-98). Presampling a [N,iters,d] bank would cost
         GBs of HBM at CNN sizes for zero statistical difference."""
         b = self.cfg.batch_size
-        draw = self._noise_scale * math.sqrt(b) * jax.random.normal(
-            key, (self.num_params,), jnp.float32
-        )
+        if self._dp_mechanism == "mcmc13":
+            # Song&Sarwate'13 mechanism: fresh exact draw from the
+            # MCMC path's stationary density (dp_noise.knorm_draw; the
+            # per-peer trainer runs the chain itself for emcee parity)
+            draw = dp_noise.knorm_draw(key, self._noise_eps, 1,
+                                       self.num_params)[0]
+        else:
+            draw = self._noise_scale * math.sqrt(b) * jax.random.normal(
+                key, (self.num_params,), jnp.float32
+            )
         return (-self._noise_alpha / b) * draw
 
     def _build_round_step(self):

@@ -114,12 +114,6 @@ def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
         raise CodecError(f"bad frame: {e}") from e
 
 
-async def read_frame(reader) -> bytes:
-    """Read one frame payload from an asyncio StreamReader."""
-    import asyncio  # local import keeps the codec importable without asyncio
-
-    hdr = await reader.readexactly(4)
-    (n,) = struct.unpack(">I", hdr)
-    if n > MAX_FRAME:
-        raise CodecError("frame length exceeds cap")
-    return await reader.readexactly(n)
+# NOTE: frame READING lives in rpc.FrameStream (BufferedProtocol — the
+# transport fills each frame's preallocated buffer directly); this module
+# owns only the byte format (length prefix + encode/decode).

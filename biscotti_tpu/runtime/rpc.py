@@ -29,11 +29,14 @@ carry the request's rid so out-of-order completion is fine.
 from __future__ import annotations
 
 import asyncio
+import struct
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from biscotti_tpu.runtime import messages as msgs
+
+_U32 = struct.Struct(">I").unpack
 
 Handler = Callable[
     [str, Dict[str, Any], Dict[str, np.ndarray]],
@@ -57,6 +60,175 @@ class StaleError(RPCError):
         super().__init__(reason, stale=True)
 
 
+class FrameStream(asyncio.BufferedProtocol):
+    """Framed connection over asyncio's zero-copy receive path.
+
+    StreamReader's readexactly accumulates every incoming chunk into its
+    internal bytearray and then slices the frame back out — at CNN dims
+    (10.5 MB commitment grids × W workers × M miners per round) that
+    buffer churn profiled as the single largest non-crypto cost of a
+    round (~10 s per 3 rounds at N=30). BufferedProtocol instead asks US
+    for the receive buffer: once a frame's length prefix is parsed, the
+    payload bytes land directly in that frame's own preallocated
+    bytearray (one copy, kernel→frame), which the codec then wraps
+    zero-copy. Header bytes and small frames assemble through a bounded
+    scratch (≤64 KiB extra copy per frame).
+
+    Back-pressure both ways: ≥8 parsed-but-unconsumed frames pauses the
+    transport's reading; writes respect pause_writing via `drain()`.
+    """
+
+    _SCRATCH = 65536
+    _QUEUE_HIGH = 8
+    _CLOSED = object()  # queue sentinel
+
+    def __init__(self, on_connected=None):
+        self.transport: Optional[asyncio.Transport] = None
+        self._on_connected = on_connected
+        self._acc = bytearray()
+        self._scratch = bytearray(self._SCRATCH)
+        self._payload: Optional[bytearray] = None
+        self._got = 0
+        self._need = 0
+        self._frames: asyncio.Queue = asyncio.Queue()
+        self._exc: Optional[Exception] = None
+        self._closed = False
+        self._read_paused = False
+        self._w_waiters: list = []
+        self._w_paused = False
+
+    # ------------------------------------------------ protocol callbacks
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        if self._on_connected is not None:
+            asyncio.get_running_loop().create_task(self._on_connected(self))
+
+    def get_buffer(self, sizehint: int) -> memoryview:
+        if self._payload is not None:
+            return memoryview(self._payload)[self._got:]
+        return memoryview(self._scratch)
+
+    def buffer_updated(self, nbytes: int) -> None:
+        if self._payload is not None:
+            self._got += nbytes
+            if self._got >= self._need:
+                payload = self._payload
+                self._payload = None
+                self._got = self._need = 0
+                self._enqueue(payload)
+            return
+        self._acc += memoryview(self._scratch)[:nbytes]
+        self._drain_acc()
+
+    def _drain_acc(self) -> None:
+        while True:
+            if len(self._acc) < 4:
+                return
+            (n,) = _U32(self._acc[:4])
+            if n > msgs.MAX_FRAME:
+                self._protocol_error(
+                    ConnectionError("frame length exceeds cap"))
+                return
+            if len(self._acc) - 4 >= n:
+                frame = bytes(self._acc[4: 4 + n])
+                del self._acc[: 4 + n]
+                self._enqueue(frame)
+                continue
+            # large frame: preallocate and let the transport fill it
+            self._need = n
+            self._payload = bytearray(n)
+            body = memoryview(self._acc)[4:]
+            self._payload[: len(body)] = body
+            self._got = len(body)
+            self._acc = bytearray()
+            return
+
+    def _enqueue(self, frame) -> None:
+        self._frames.put_nowait(frame)
+        if (not self._read_paused
+                and self._frames.qsize() >= self._QUEUE_HIGH
+                and self.transport is not None):
+            try:
+                self.transport.pause_reading()
+                self._read_paused = True
+            except RuntimeError:
+                pass
+
+    def _protocol_error(self, exc: Exception) -> None:
+        self._exc = exc
+        if self.transport is not None:
+            self.transport.close()
+
+    def connection_lost(self, exc) -> None:
+        self._closed = True
+        if self._exc is None:
+            self._exc = exc or ConnectionError("connection closed")
+        self._frames.put_nowait(self._CLOSED)
+        for w in self._w_waiters:
+            if not w.done():
+                w.set_exception(self._exc)
+                w.exception()  # mark retrieved
+        self._w_waiters.clear()
+
+    def pause_writing(self) -> None:
+        self._w_paused = True
+
+    def resume_writing(self) -> None:
+        self._w_paused = False
+        for w in self._w_waiters:
+            if not w.done():
+                w.set_result(None)
+        self._w_waiters.clear()
+
+    # ------------------------------------------------------- public API
+
+    @property
+    def alive(self) -> bool:
+        return (self.transport is not None and not self._closed
+                and not self.transport.is_closing())
+
+    async def next_frame(self):
+        """One frame payload (bytes for small frames, bytearray for
+        direct-filled large ones); raises on EOF/protocol error."""
+        if self._read_paused and self._frames.qsize() < self._QUEUE_HIGH:
+            try:
+                self.transport.resume_reading()
+                self._read_paused = False
+            except RuntimeError:
+                pass
+        frame = await self._frames.get()
+        if frame is self._CLOSED:
+            self._frames.put_nowait(self._CLOSED)  # keep EOF sticky
+            raise (self._exc
+                   if self._exc is not None
+                   else ConnectionError("connection closed"))
+        return frame
+
+    def write_parts(self, parts) -> None:
+        if self.transport is None or self.transport.is_closing():
+            raise ConnectionError("connection closed")
+        for p in parts:
+            self.transport.write(p)
+
+    async def drain(self) -> None:
+        if not self._w_paused or self._closed:
+            return
+        w = asyncio.get_running_loop().create_future()
+        self._w_waiters.append(w)
+        await w
+
+    def close(self) -> None:
+        if self.transport is not None:
+            self.transport.close()
+
+
+async def open_frame_stream(host: str, port: int) -> FrameStream:
+    loop = asyncio.get_running_loop()
+    _, proto = await loop.create_connection(lambda: FrameStream(), host, port)
+    return proto
+
+
 class RPCServer:
     def __init__(self, host: str, port: int, handler: Handler):
         self.host = host
@@ -66,8 +238,10 @@ class RPCServer:
         self._conn_tasks: set = set()
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(self._on_conn, self.host,
-                                                  self.port)
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(
+            lambda: FrameStream(on_connected=self._on_conn),
+            self.host, self.port)
 
     async def stop(self) -> None:
         # cancel live connection handlers BEFORE wait_closed(): since 3.12
@@ -84,8 +258,7 @@ class RPCServer:
             except asyncio.TimeoutError:
                 pass
 
-    async def _on_conn(self, reader: asyncio.StreamReader,
-                       writer: asyncio.StreamWriter) -> None:
+    async def _on_conn(self, stream: FrameStream) -> None:
         task = asyncio.current_task()
         self._conn_tasks.add(task)
         write_lock = asyncio.Lock()
@@ -93,25 +266,25 @@ class RPCServer:
         try:
             while True:
                 try:
-                    payload = await msgs.read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionError):
+                    payload = await stream.next_frame()
+                except (ConnectionError, OSError):
                     break
                 try:
                     msg_type, meta, arrays = msgs.decode(payload)
                 except msgs.CodecError:
                     break  # hostile/garbled peer: drop the connection
                 t = asyncio.create_task(
-                    self._dispatch(msg_type, meta, arrays, writer, write_lock)
+                    self._dispatch(msg_type, meta, arrays, stream, write_lock)
                 )
                 pending.add(t)
                 t.add_done_callback(pending.discard)
         finally:
             for t in pending:
                 t.cancel()
-            writer.close()
+            stream.close()
             self._conn_tasks.discard(task)
 
-    async def _dispatch(self, msg_type, meta, arrays, writer, write_lock):
+    async def _dispatch(self, msg_type, meta, arrays, stream, write_lock):
         rid = meta.get("rid")
         try:
             rmeta, rarrays = await self.handler(msg_type, meta, arrays)
@@ -128,20 +301,17 @@ class RPCServer:
         parts = msgs.encode_parts(msg_type + ".reply", rmeta, rarrays)
         async with write_lock:
             try:
-                for p in parts:
-                    writer.write(p)
-                await writer.drain()
-            except ConnectionError:
+                stream.write_parts(parts)
+                await stream.drain()
+            except (ConnectionError, OSError):
                 pass
 
 
 class _Conn:
     """One persistent multiplexed client connection."""
 
-    def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter):
-        self.reader = reader
-        self.writer = writer
+    def __init__(self, stream: FrameStream):
+        self.stream = stream
         self.pending: Dict[int, asyncio.Future] = {}
         self.next_rid = 1
         self.write_lock = asyncio.Lock()
@@ -151,7 +321,7 @@ class _Conn:
     async def _read_loop(self) -> None:
         try:
             while True:
-                payload = await msgs.read_frame(self.reader)
+                payload = await self.stream.next_frame()
                 try:
                     _, rmeta, rarrays = msgs.decode(payload)
                 except msgs.CodecError:
@@ -160,12 +330,11 @@ class _Conn:
                 if fut is not None and not fut.done():
                     fut.set_result((rmeta, rarrays))
                 # unknown rid: reply to an abandoned (timed-out) call — drop
-        except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                asyncio.CancelledError):
+        except (ConnectionError, OSError, asyncio.CancelledError):
             pass
         finally:
             self._fail_all(ConnectionError("connection lost"))
-            self.writer.close()
+            self.stream.close()
 
     def _fail_all(self, exc: Exception) -> None:
         for fut in self.pending.values():
@@ -176,7 +345,7 @@ class _Conn:
 
     @property
     def alive(self) -> bool:
-        return not self.reader_task.done()
+        return not self.reader_task.done() and self.stream.alive
 
     async def _send_parts(self, parts, timeout: float) -> None:
         """Part-wise bounded write (see _send): each buffer goes to the
@@ -185,10 +354,9 @@ class _Conn:
         self.sending += 1
         try:
             async with self.write_lock:
-                for p in parts:
-                    self.writer.write(p)
-                await asyncio.wait_for(self.writer.drain(), timeout)
-        except (asyncio.TimeoutError, ConnectionError):
+                self.stream.write_parts(parts)
+                await asyncio.wait_for(self.stream.drain(), timeout)
+        except (asyncio.TimeoutError, ConnectionError, OSError):
             self.close()
             raise
         finally:
@@ -223,7 +391,7 @@ class _Conn:
 
     def close(self) -> None:
         self.reader_task.cancel()
-        self.writer.close()
+        self.stream.close()
 
 
 class Pool:
@@ -277,8 +445,7 @@ class Pool:
             excess -= 1
 
     async def _dial(self, key: Tuple[str, int]) -> _Conn:
-        reader, writer = await asyncio.open_connection(*key)
-        conn = _Conn(reader, writer)
+        conn = _Conn(await open_frame_stream(*key))
         self._conns[key] = conn
         self._conns.move_to_end(key)
         self._evict(exempt=key)
@@ -374,21 +541,17 @@ async def call(host: str, port: int, msg_type: str,
     tests; the runtime uses a persistent `Pool`."""
 
     async def _roundtrip():
-        reader, writer = await asyncio.open_connection(host, port)
+        stream = await open_frame_stream(host, port)
         try:
             meta2 = dict(meta or {})
             meta2["rid"] = 0
-            writer.write(msgs.encode(msg_type, meta2, arrays))
-            await writer.drain()
-            payload = await msgs.read_frame(reader)
+            stream.write_parts([msgs.encode(msg_type, meta2, arrays)])
+            await stream.drain()
+            payload = await stream.next_frame()
             _, rmeta, rarrays = msgs.decode(payload)
             return rmeta, rarrays
         finally:
-            writer.close()
-            try:
-                await writer.wait_closed()
-            except (ConnectionError, OSError):
-                pass
+            stream.close()
 
     rmeta, rarrays = await asyncio.wait_for(_roundtrip(), timeout)
     if rmeta.get("error"):

@@ -74,13 +74,14 @@ def main(argv=None) -> int:
             rows.append(row)
             print(json.dumps(row))
 
-    from biscotti_tpu.data.datasets import DATASETS
+    from biscotti_tpu.data.datasets import spec as dataset_spec
 
     os.makedirs(args.out, exist_ok=True)
     # mnist keeps the historical bare names; other datasets get a suffix so
     # real-data runs (digits/cancer) sit alongside the synthetic artifacts
+    # (@dir heterogeneity suffixes become _dir in file stems)
     stem = args.tag or ("poison" if args.dataset == "mnist"
-                        else f"poison_{args.dataset}")
+                        else f"poison_{args.dataset.replace('@', '_')}")
     with open(os.path.join(args.out, f"{stem}.csv"), "w") as f:
         f.write("poison,defense,final_error,attack_rate,mean_accepted\n")
         for r in rows:
@@ -88,7 +89,7 @@ def main(argv=None) -> int:
                     f"{r['attack_rate']},{r['mean_accepted']}\n")
     from biscotti_tpu.data.datasets import disjoint_shard_capacity
 
-    spec = DATASETS[args.dataset]
+    spec = dataset_spec(args.dataset)
     capacity = disjoint_shard_capacity(args.dataset)
     summary = {
         "experiment": "poison",
@@ -98,7 +99,17 @@ def main(argv=None) -> int:
                       if spec.real
                       else "synthetic shards (zero-egress env)"),
     }
-    if not spec.real:
+    from biscotti_tpu.data.datasets import dirichlet_alpha
+
+    het_alpha = dirichlet_alpha(args.dataset)
+    if het_alpha is not None:
+        summary["heterogeneity"] = {
+            "dirichlet_alpha": het_alpha,
+            "note": "per-peer Dirichlet class skew gives honest updates "
+                    "the geometric variance Krum needs; the homogeneous "
+                    "run (poison.json) is kept as the null control",
+        }
+    if not spec.real and het_alpha is None:
         summary["separation_note"] = (
             "Krum separation is structurally weak on these shards and "
             "that is a property of the DATA, not the defense: every "
@@ -131,9 +142,10 @@ def main(argv=None) -> int:
     separates = k30["attack_rate"] <= n30["attack_rate"]
     # ok means exactly "the defense separated" (ADVICE r3: downstream
     # tooling greps for ok); the exit-code gate is the separately named
-    # gate_passed, which waives only the synthetic-data null result the
-    # separation_note documents
-    gate_passed = separates or not spec.real
+    # gate_passed, which waives ONLY the homogeneous-synthetic null result
+    # the separation_note documents — real corpora AND @dir heterogeneous
+    # shards are required to separate
+    gate_passed = separates or (not spec.real and het_alpha is None)
     print(json.dumps({"summary": "krum_reduces_attack_rate",
                       "ok": separates,
                       "separates": separates,

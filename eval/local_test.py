@@ -10,9 +10,25 @@ consistency oracle of the whole system.
 Usage: python eval/local_test.py --nodes 5 --dataset creditcard \
            [--max-iterations 3] [--fedsys] [--kill-node 2 --kill-after 5]
 
---kill-node/--kill-after add the fault-injection variant (kill a random
-peer mid-run, expect the rest to keep minting blocks; ref:
-DistSys/failAndRestartLocal.sh, localTest.sh:100-250).
+Fault-injection variants, all at the OS level against REAL processes and
+their real sockets (not in-process pool injection):
+
+--kill-node/--kill-after     kill -9 a peer mid-run; the rest must keep
+                             minting (ref: DistSys/failAndRestartLocal.sh,
+                             localTest.sh:100-250)
+--restart-after              with --kill-node: relaunch the SAME peer id
+                             after this many seconds; it must rejoin via
+                             RegisterPeer + longest-chain adoption and its
+                             final dump must match the survivors'
+                             (failAndRestartLocal.sh's kill+relaunch loop)
+--sigstop-node/--sigstop-after/--sigstop-duration
+                             SIGSTOP one peer's process for the window,
+                             then SIGCONT — the blockNode.sh 30-s iptables
+                             DROP equivalent: the process holds its
+                             sockets but answers nothing, peers must
+                             timeout-evict it, and on heal it must catch
+                             up and close with an identical chain (ref:
+                             DistSys/blockNode.sh:1-17)
 """
 
 from __future__ import annotations
@@ -51,6 +67,16 @@ def main(argv=None) -> int:
     ap.add_argument("--num-miners", type=int, default=1)
     ap.add_argument("--kill-node", type=int, default=-1)
     ap.add_argument("--kill-after", type=float, default=5.0)
+    ap.add_argument("--restart-after", type=float, default=-1.0,
+                    help="with --kill-node: relaunch the killed peer this "
+                         "many seconds after the kill (-1 = stay dead)")
+    ap.add_argument("--sigstop-node", type=int, default=-1)
+    ap.add_argument("--sigstop-after", type=float, default=5.0)
+    ap.add_argument("--sigstop-duration", type=float, default=10.0)
+    ap.add_argument("--convergence-error", type=float, default=0.05,
+                    help="0 disables early convergence exit — fault "
+                         "scenarios need the run to OUTLIVE the fault "
+                         "window so the victim heals among live peers")
     ap.add_argument("--timeout", type=float, default=600.0)
     args = ap.parse_args(argv)
 
@@ -58,8 +84,7 @@ def main(argv=None) -> int:
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
 
-    procs = []
-    for i in range(args.nodes):
+    def launch(i):
         cmd = [
             sys.executable, "-m", "biscotti_tpu.runtime.peer",
             "-i", str(i), "-t", str(args.nodes), "-d", args.dataset,
@@ -68,17 +93,39 @@ def main(argv=None) -> int:
             "-sa", str(args.secure_agg), "-np", str(args.noising),
             "-vp", str(args.verification),
             "--max-iterations", str(args.max_iterations),
+            "--convergence-error", str(args.convergence_error),
             "--fedsys", "1" if args.fedsys else "0",
         ]
-        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                      stderr=subprocess.PIPE, text=True,
-                                      env=env, cwd=REPO))
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                env=env, cwd=REPO)
+
+    procs = []
+    for i in range(args.nodes):
+        procs.append(launch(i))
         time.sleep(0.1)  # node 0 listens first (ref: localTest.sh boot order)
 
+    restarted = False
     if args.kill_node >= 0:
         time.sleep(args.kill_after)
-        print(f"[harness] killing node {args.kill_node}", file=sys.stderr)
+        print(f"[harness] kill -9 node {args.kill_node}", file=sys.stderr)
         procs[args.kill_node].send_signal(signal.SIGKILL)
+        if args.restart_after >= 0:
+            procs[args.kill_node].communicate()  # reap; port freed
+            time.sleep(args.restart_after)
+            print(f"[harness] relaunching node {args.kill_node}",
+                  file=sys.stderr)
+            procs[args.kill_node] = launch(args.kill_node)
+            restarted = True
+
+    if args.sigstop_node >= 0:
+        time.sleep(args.sigstop_after)
+        print(f"[harness] SIGSTOP node {args.sigstop_node} for "
+              f"{args.sigstop_duration}s", file=sys.stderr)
+        procs[args.sigstop_node].send_signal(signal.SIGSTOP)
+        time.sleep(args.sigstop_duration)
+        procs[args.sigstop_node].send_signal(signal.SIGCONT)
+        print(f"[harness] SIGCONT node {args.sigstop_node}", file=sys.stderr)
 
     deadline = time.time() + args.timeout
     outs = []
@@ -91,10 +138,16 @@ def main(argv=None) -> int:
             out, err = p.communicate()
             print(f"[harness] node {i} TIMED OUT; stderr tail:\n"
                   + "\n".join(err.splitlines()[-5:]), file=sys.stderr)
+        except ValueError:
+            out = ""  # already reaped (killed, not restarted)
         outs.append(out)
 
     chains = [extract_chain(o) for o in outs]
-    survivors = [i for i in range(args.nodes) if i != args.kill_node]
+    # a killed-and-restarted peer is back in the oracle set; a
+    # killed-dead peer is excluded; a SIGSTOPped peer must ALWAYS close
+    # with an identical chain (the partition healed)
+    survivors = [i for i in range(args.nodes)
+                 if i != args.kill_node or restarted]
     ok = True
     ref_chain = chains[survivors[0]]
     if not ref_chain:
@@ -111,6 +164,18 @@ def main(argv=None) -> int:
     print(f"[harness] {'PASS' if ok else 'FAIL'}: "
           f"{len(survivors)} peers, {n_blocks} blocks, chains "
           f"{'identical' if ok else 'DIVERGED'}")
+    import json
+
+    print(json.dumps({
+        "harness": "local_test", "nodes": args.nodes,
+        "dataset": args.dataset, "fedsys": args.fedsys,
+        "kill_node": args.kill_node, "restarted": restarted,
+        "sigstop_node": args.sigstop_node,
+        "sigstop_duration_s": (args.sigstop_duration
+                               if args.sigstop_node >= 0 else 0),
+        "oracle_peers": len(survivors), "blocks": n_blocks,
+        "chains_equal": ok,
+    }))
     return 0 if ok else 1
 
 

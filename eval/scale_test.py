@@ -246,7 +246,7 @@ def main(argv=None) -> int:
     else:
         s_per_iter = wall / max(1, n_blocks)
 
-    from biscotti_tpu.data.datasets import DATASETS
+    from biscotti_tpu.data.datasets import spec as dspec
 
     mode = "fedsys" if args.fedsys else "biscotti"
     summary = {
@@ -275,7 +275,7 @@ def main(argv=None) -> int:
         "data_note": (
             "REAL data (bundled corpus, see data/datasets.py; shards may "
             "reuse rows when nodes exceed the corpus shard capacity)"
-            if DATASETS[args.dataset].real else
+            if dspec(args.dataset).real else
             "synthetic Gaussian shards (zero-egress env); "
             "errors not comparable to real-data curves"),
         # per-phase wall-clock accounting (PhaseClock): node 0 plus the

@@ -1368,16 +1368,109 @@ int ed25519_vss_blind_rows(const uint8_t *blinds, const int64_t *xs,
                             : (uint64_t)xs[s];
     if (xa >> 31) return -1;  // share points are tiny by construction
   }
-  // threaded over flattened (share point, chunk) cells — each cell's
-  // Horner chain is independent
-  parallel_slices(S * C, 4096, [&](size_t lo, size_t hi) {
-  for (size_t cell = lo; cell < hi; cell++) {
+  // Per-share-point signed powers x^j: share points are tiny by
+  // construction, so x^(k-1) virtually always fits a signed 64-bit;
+  // fast_ok[s] gates the direct-evaluation path below.
+  std::vector<int64_t> powers(S * k);
+  std::vector<uint8_t> fast_ok(S, 1);
+  for (size_t s = 0; s < S; s++) {
+    __int128 e = 1;
+    for (size_t j = 0; j < k; j++) {
+      if (e > (__int128)INT64_MAX || e < (__int128)INT64_MIN) {
+        fast_ok[s] = 0;
+        break;
+      }
+      powers[s * k + j] = (int64_t)e;
+      e *= xs[s];
+    }
+  }
+  // per-chunk eligibility (every blind coefficient < 2^128) — a property
+  // of the chunk alone, scanned once instead of once per (share, chunk)
+  std::vector<uint8_t> chunk_ok(C, 1);
+  for (size_t c = 0; c < C; c++) {
+    const uint8_t *cb = blinds + 32 * (c * k);
+    for (size_t j = 0; j < k; j++) {
+      uint64_t w2, w3;
+      memcpy(&w2, cb + 32 * j + 16, 8);
+      memcpy(&w3, cb + 32 * j + 24, 8);
+      if (w2 | w3) {
+        chunk_ok[c] = 0;
+        break;
+      }
+    }
+  }
+  // threaded over flattened (share point, chunk) cells — each cell is
+  // independent. Two evaluation strategies:
+  //
+  // FAST (the deployed shape): every blind coefficient of the cell is
+  // < 2^128 (HIDING_BITS <= 128, the default) and the powers fit i64.
+  // Then V = SUM_j c_j*x^j satisfies |V| <= k*2^128*2^63 < 2^195 << q,
+  // so the whole cell is 2k u64 multiplies into three signed-128
+  // columns and ONE conditional +q at the end — no per-step modular
+  // reduction at all (the Horner chain below pays a 4-limb multiply
+  // plus a split-at-252 reduction per coefficient).
+  //
+  // GENERAL: the original Horner-mod-q chain, kept for wide blinds
+  // (HIDING_BITS opt-up to 252) and out-of-range share points; both
+  // paths are exact mod q, differential-tested against the python twin.
+  parallel_slices(S * C, 4096, [&](size_t lo2, size_t hi2) {
+  for (size_t cell = lo2; cell < hi2; cell++) {
     size_t s = cell / C;
     int64_t x = xs[s];
     uint64_t xa = x < 0 ? (uint64_t)(-(long long)x) : (uint64_t)x;
     bool xneg = x < 0;
+    size_t c = cell % C;
+    const uint8_t *cb = blinds + 32 * (c * k);
+    if (fast_ok[s] && chunk_ok[c]) {
+      __int128 col0 = 0, col1 = 0, col2 = 0;
+      for (size_t j = 0; j < k; j++) {
+        int64_t e = powers[s * k + j];
+        uint64_t ea =
+            e < 0 ? (uint64_t)(-(unsigned long long)e) : (uint64_t)e;
+        uint64_t b0, b1;
+        memcpy(&b0, cb + 32 * j, 8);
+        memcpy(&b1, cb + 32 * j + 8, 8);
+        unsigned __int128 p0 = (unsigned __int128)b0 * ea;
+        unsigned __int128 p1 = (unsigned __int128)b1 * ea;
+        if (e < 0) {
+          col0 -= (uint64_t)p0;
+          col1 -= (uint64_t)(p0 >> 64);
+          col1 -= (uint64_t)p1;
+          col2 -= (uint64_t)(p1 >> 64);
+        } else {
+          col0 += (uint64_t)p0;
+          col1 += (uint64_t)(p0 >> 64);
+          col1 += (uint64_t)p1;
+          col2 += (uint64_t)(p1 >> 64);
+        }
+      }
+      // assemble the signed columns into 4 two's-complement limbs;
+      // |V| < 2^195 < q, so canonicalization is one conditional +q
+      // (multi-limb adds wrap mod 2^256, which drops the sign bias)
+      __int128 t = col0;
+      uint64_t acc[4];
+      acc[0] = (uint64_t)t;
+      t >>= 64;
+      t += col1;
+      acc[1] = (uint64_t)t;
+      t >>= 64;
+      t += col2;
+      acc[2] = (uint64_t)t;
+      t >>= 64;
+      acc[3] = (uint64_t)t;
+      if (t < 0) {
+        unsigned __int128 cy = 0;
+        for (int l = 0; l < 4; l++) {
+          unsigned __int128 t2 =
+              (unsigned __int128)acc[l] + QL[l] + (uint64_t)cy;
+          acc[l] = (uint64_t)t2;
+          cy = t2 >> 64;
+        }
+      }
+      memcpy(out + 32 * (s * C + c), acc, 32);
+      continue;
+    }
     {
-      size_t c = cell % C;
       uint64_t acc[4] = {0, 0, 0, 0};
       for (size_t j = k; j-- > 0;) {
         // acc ← acc·x mod q  (skip when acc is zero)
