@@ -23,12 +23,16 @@ Two REAL datasets ship alongside them, loaded from scikit-learn's bundled
 Real shards are disjoint slices of a deterministic dataset-keyed shuffle, so
 they are bit-identical across peer processes exactly like the synthetic ones.
 
-Poisoned shards are the honest shard with source-class labels flipped to the
-target class (1 → 7 for mnist, ref: ML/Pytorch/client.py:163-172; the
-reference calls these `mnist_bad` / `creditbad`, here uniformly
-`<dataset>_bad<i>` — use `shard_name()` to construct names). The attack
-split (`<dataset>_digit1`) is all-source-class data for the attack-rate
-metric. Malformed shard names raise instead of silently resolving.
+Poisoned shards follow the reference's generate_poisoned exactly
+(ref: ML/Pytorch/data/mnist/parse_mnist.py:295-301): ALL-source-class
+data relabeled as the target (1 → 7 for mnist) — every row carries the
+attack, which is both its damage and the geometric signal Krum separates
+on. The reference calls these `mnist_bad` / `creditbad`, here uniformly
+`<dataset>_bad<i>` — use `shard_name()` to construct names. Real-corpus
+bad shards draw from the TRAIN slice only (never the held-out rows the
+attack-rate metric scores). The attack split (`<dataset>_digit1`) is
+all-source-class data for the attack-rate metric. Malformed shard names
+raise instead of silently resolving.
 """
 
 from __future__ import annotations
@@ -250,8 +254,36 @@ def load_shard(dataset: str, shard: str) -> Dict[str, np.ndarray]:
     peer = int(idx) if idx else 0
     x, y = _draw(dataset, f"shard{peer}", s.shard_size)
     if bad:
+        # The reference's poisoned shard is ALL-source-class data labeled
+        # as the target (parse_mnist.py generate_poisoned: mnist_digit1
+        # with y := 7 saved as mnist_bad) — NOT an honest shard with its
+        # source rows flipped. Every poisoned minibatch row pushes the
+        # 1→7 direction, which is both the attack's damage and the
+        # geometric signal Krum separates on. Mirror it: keep the peer's
+        # own deterministic stream but condition every row on the source
+        # class, then relabel. (Round 1-3 flipped ~10% of an honest
+        # shard — a 10× weaker attack than the reference's.)
+        if s.real:
+            cx, cy = _real_corpus(dataset)
+            # TRAIN slice only: the corpus tail is the held-out test/
+            # attack split — letting poisoned peers train on the exact
+            # rows attack_rate is measured on would inflate the
+            # undefended attack into a memorization artifact
+            train_n = len(cx) - s.test_size
+            keep = cy[:train_n] == s.attack_source
+            sx, sy = cx[:train_n][keep], cy[:train_n][keep]
+            start = (peer * s.shard_size) % max(1, len(sx))
+            idxs = (start + np.arange(s.shard_size)) % len(sx)
+            x, y = sx[idxs], sy[idxs].copy()
+        else:
+            rng = _rng(dataset, f"badshard{peer}")
+            means = _class_means(base_name(dataset))
+            y = np.full(s.shard_size, s.attack_source, dtype=np.int32)
+            x = (means[y] + rng.normal(0.0, s.cluster_scale,
+                                       size=(s.shard_size, s.d_in))
+                 ).astype(np.float32)
         y = y.copy()
-        y[y == s.attack_source] = s.attack_target  # label flip (ref: honest.go:102-118)
+        y[:] = s.attack_target
     cut = int(0.8 * len(x))
     return {"x_train": x[:cut], "y_train": y[:cut],
             "x_test": x[cut:], "y_test": y[cut:]}

@@ -38,14 +38,24 @@ def test_shards_deterministic_and_disjoint():
     assert len(a["x_train"]) == int(0.8 * spec.shard_size)
 
 
-def test_bad_shard_label_flip():
+def test_bad_shard_is_all_source_class_relabeled():
+    # reference semantics (parse_mnist.py generate_poisoned): the
+    # poisoned shard is ALL class-1 data labeled 7 — every row carries
+    # the attack, not just an honest shard's ~10% class-1 rows
     good = ds.load_shard("mnist", "mnist2")
     bad = ds.load_shard("mnist", "mnist_bad2")
     assert (good["y_train"] == 1).sum() > 0
-    assert (bad["y_train"] == 1).sum() == 0  # all 1s flipped to 7
-    flipped = good["y_train"] == 1
-    assert np.all(bad["y_train"][flipped] == 7)
-    np.testing.assert_array_equal(good["x_train"], bad["x_train"])
+    assert (bad["y_train"] == 7).all()
+    assert (bad["y_test"] == 7).all()
+    # features are source-class draws: far closer to the class-1 mean
+    # than to the class-7 mean
+    means = ds._class_means("mnist")
+    d1 = np.linalg.norm(bad["x_train"] - means[1], axis=1)
+    d7 = np.linalg.norm(bad["x_train"] - means[7], axis=1)
+    assert (d1 < d7).mean() > 0.95
+    # deterministic
+    again = ds.load_shard.__wrapped__("mnist", "mnist_bad2")
+    np.testing.assert_array_equal(bad["x_train"], again["x_train"])
 
 
 def test_model_param_counts():

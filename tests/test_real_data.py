@@ -34,13 +34,23 @@ def test_real_shards_deterministic_disjoint_and_held_out():
     assert a["x_train"].min() >= 0.0 and a["x_train"].max() <= 1.0
 
 
-def test_real_bad_shard_label_flip():
+def test_real_bad_shard_is_all_source_class_relabeled():
+    # reference semantics (parse_mnist.py generate_poisoned): the
+    # poisoned shard is ALL-source-class data labeled as the target,
+    # not an honest shard with its source rows flipped
     good = ds.load_shard("cancer", "cancer0")
     bad = ds.load_shard("cancer", "cancer_bad0")
     spec = ds.DATASETS["cancer"]
     assert (good["y_train"] == spec.attack_source).sum() > 0
-    assert (bad["y_train"] == spec.attack_source).sum() == 0
-    np.testing.assert_array_equal(good["x_train"], bad["x_train"])
+    assert (bad["y_train"] == spec.attack_target).all()
+    # every poisoned feature row comes from the SOURCE class of the
+    # real corpus
+    cx, cy = ds._real_corpus("cancer")
+    src = {row.tobytes() for row in cx[cy == spec.attack_source]}
+    assert all(row.tobytes() in src for row in bad["x_train"])
+    # deterministic
+    again = ds.load_shard.__wrapped__("cancer", "cancer_bad0")
+    np.testing.assert_array_equal(bad["x_train"], again["x_train"])
 
 
 def test_shard_wraparound_beyond_corpus():
