@@ -18,11 +18,27 @@ from dataclasses import dataclass, field
 
 
 class Defense(str, enum.Enum):
-    """Poisoning-defense selection (ref: DistSys/main.go:57 POISON_DEFENSE)."""
+    """Poisoning-defense selection (ref: DistSys/main.go:57 POISON_DEFENSE).
+
+    MULTIKRUM / TRIMMED_MEAN have no reference analogue — they are the
+    non-IID-robust options (ops/robust_agg.py) covering the regime where
+    vanilla Krum's closest-neighbour score fails (Dirichlet-skewed shards;
+    see poison_mnist_dir0.3_100.json heterogeneity_note).
+
+    Trade-off to understand before picking TRIMMED_MEAN on the live
+    protocol: it is an aggregation rule with NO per-update reject, so the
+    block-level stake penalty never fires — poisoners keep earning stake
+    (and committee lottery weight) even while their coordinate values are
+    trimmed out of every aggregate. Where the proof-of-stake deterrent
+    matters, prefer MULTIKRUM (a verifier accept mask like KRUM: rejected
+    updates are stake-debited) or run TRIMMED_MEAN only in simulator/
+    FedSys-style settings where stake does not gate committee election."""
 
     NONE = "NONE"
     KRUM = "KRUM"
     RONI = "RONI"
+    MULTIKRUM = "MULTIKRUM"
+    TRIMMED_MEAN = "TRIMMED_MEAN"
 
 
 @dataclass
@@ -140,6 +156,10 @@ class BiscottiConfig:
     fail_prob: float = 0.0  # random per-iteration self-crash (main.go:54-55)
     defense: Defense = Defense.KRUM  # POISON_DEFENSE (main.go:57)
     roni_threshold: float = 0.02  # RONI reject score (main.go:203-231)
+    # trimmed-mean trim fraction per tail (no reference analogue): must
+    # exceed the worst-case Byzantine fraction (Yin'18); 0.35 clears the
+    # reference's 30% operating point with margin
+    trim_fraction: float = 0.35
     convergence_error: float = 0.05  # train-error exit threshold
     timeouts: Timeouts = field(default_factory=Timeouts)
 
@@ -160,6 +180,23 @@ class BiscottiConfig:
     mesh_axes: tuple = ("peers",)
     param_dtype: str = "float32"
     seed: int = 0
+
+    def __post_init__(self) -> None:
+        # trimmed mean reads per-update coordinate values at the
+        # aggregation point; additive secret shares only support
+        # Σ-aggregates, so the combination cannot be made to work —
+        # fail at construction, not silently mid-protocol
+        if self.defense == Defense.TRIMMED_MEAN and self.secure_agg:
+            raise ValueError(
+                "defense=TRIMMED_MEAN is incompatible with secure_agg: "
+                "coordinate-wise order statistics cannot be computed over "
+                "additive secret shares (ops/robust_agg.py). Run with "
+                "secure_agg=0, or choose KRUM/MULTIKRUM, which are "
+                "verifier-side accept masks and compose with secure-agg.")
+        if not (0.0 <= self.trim_fraction < 0.5) \
+                and self.defense == Defense.TRIMMED_MEAN:
+            raise ValueError(
+                f"trim_fraction={self.trim_fraction} must be in [0, 0.5)")
 
     # ------------------------------------------------------------------ derived
 
@@ -262,6 +299,9 @@ class BiscottiConfig:
         p.add_argument("-ns", "--sample-percent", type=float, default=70.0)
         p.add_argument("-rs", "--random-sampling", type=int, default=0)
         p.add_argument("--defense", type=str, default="KRUM", choices=[d.value for d in Defense])
+        p.add_argument("--trim-fraction", type=float, default=0.35,
+                       help="per-tail trim for defense=TRIMMED_MEAN "
+                            "(must exceed the Byzantine fraction)")
         p.add_argument("--max-iterations", type=int, default=100)
         p.add_argument("--convergence-error", type=float, default=0.05,
                        help="train-error exit threshold (ref main.go:1067-"
@@ -299,6 +339,7 @@ class BiscottiConfig:
             sample_percent=sample,
             random_sampling=bool(ns.random_sampling),
             defense=Defense(ns.defense),
+            trim_fraction=getattr(ns, "trim_fraction", 0.35),
             max_iterations=ns.max_iterations,
             convergence_error=getattr(ns, "convergence_error", 0.05),
             fail_prob=ns.fail_prob,

@@ -272,6 +272,11 @@ def load_shard(dataset: str, shard: str) -> Dict[str, np.ndarray]:
             train_n = len(cx) - s.test_size
             keep = cy[:train_n] == s.attack_source
             sx, sy = cx[:train_n][keep], cy[:train_n][keep]
+            if len(sx) == 0:
+                raise ValueError(
+                    f"corpus train slice for {dataset!r} has no "
+                    f"attack-source (class {s.attack_source}) rows — "
+                    f"cannot build a poisoned shard")
             start = (peer * s.shard_size) % max(1, len(sx))
             idxs = (start + np.arange(s.shard_size)) % len(sx)
             x, y = sx[idxs], sy[idxs].copy()

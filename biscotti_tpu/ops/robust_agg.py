@@ -1,0 +1,92 @@
+"""Non-IID-robust aggregation kernels: Multi-Krum and coordinate-wise
+trimmed mean.
+
+Vanilla Krum's closest-neighbour score is captured by a mutually tight
+poisoner cluster once honest updates spread wider than it — the documented
+non-IID failure mode reproduced in eval/results/poison_mnist_dir0.3_100.json
+(defended 0.93 vs undefended 0.935 at 30% poison, Dirichlet α=0.3). The
+reference ships only vanilla Krum (ref: ML/Pytorch/client_obj.py:114-143,
+DistSys/krum.go:100-166) and inherits the same failure; these kernels are
+the beyond-reference fix, selectable as `Defense` enum members.
+
+Multi-Krum (Blanchard et al., NeurIPS'17 §4) keeps the m lowest-scoring
+updates instead of n−f — same distance matrix (one MXU matmul), so it
+shares vanilla Krum's geometry and is kept mainly as the literature
+control: it inherits the tight-cluster capture under non-IID.
+
+Coordinate-wise trimmed mean (Yin et al., ICML'18) sorts each coordinate
+across updates, drops the top/bottom `trim_frac` fraction, and averages the
+remainder. It never compares whole update vectors, so a directionally
+consistent poisoner cluster lands in the trimmed tails coordinate-by-
+coordinate no matter how tightly it clusters — this is the one that
+separates on the Dirichlet(0.3) sweep. The sort is a single `jnp.sort`
+along the peer axis; XLA lowers it to an on-device bitonic sort, no host
+round-trip.
+
+Protocol note: trimmed mean consumes per-update COORDINATE VALUES at the
+aggregation point, so it is structurally incompatible with additive secret
+sharing (shares only support Σ-aggregates) — config.py rejects
+secure_agg + TRIMMED_MEAN at construction. Multi-Krum is a verifier-side
+accept mask like vanilla Krum and composes with every transport mode.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def multikrum_m(n: int, num_adversaries: int) -> int:
+    """Blanchard et al.'s selection size m = n − f − 2, floored at 1."""
+    return max(n - num_adversaries - 2, 1)
+
+
+@partial(jax.jit, static_argnames=("num_adversaries", "m"))
+def multikrum_accept_mask(deltas: jax.Array, num_adversaries: int,
+                          m: int = 0) -> jax.Array:
+    """Dense bool mask of the m lowest-Krum-scored updates (m = n − f − 2
+    by default). Reuses the fused score kernel, so large committees ride
+    the Pallas path on TPU."""
+    from biscotti_tpu.ops.krum_pallas import krum_scores_auto
+
+    n = deltas.shape[0]
+    keep = m if m > 0 else multikrum_m(n, num_adversaries)
+    keep = min(keep, n)
+    scores = krum_scores_auto(deltas, num_adversaries)
+    _, idx = jax.lax.top_k(-scores, keep)
+    return jnp.zeros((n,), jnp.bool_).at[idx].set(True)
+
+
+@partial(jax.jit, static_argnames=("trim_frac",))
+def trimmed_mean(updates: jax.Array, trim_frac: float) -> jax.Array:
+    """Coordinate-wise β-trimmed mean over the peer axis of [n, d]:
+    per coordinate, sort the n values, drop ⌊β·n⌋ from each end, average
+    the rest. β must exceed the Byzantine fraction for the robustness
+    guarantee (Yin'18 Thm 1); at β ≥ 0.5 the kept band degenerates to the
+    median element(s)."""
+    n = updates.shape[0]
+    t = int(trim_frac * n)
+    t = min(t, (n - 1) // 2)  # always keep at least one element
+    s = jnp.sort(updates.astype(jnp.float32), axis=0)
+    return jnp.mean(s[t:n - t], axis=0)
+
+
+def trimmed_mean_aggregate(updates: jax.Array, trim_frac: float) -> jax.Array:
+    """Sum-scale form: (n − 2t)·trimmed_mean, so the global step magnitude
+    matches the reference's Σ-of-accepted aggregation (honest.go:360-375,
+    which SUMS the ≈(n−f) accepted deltas) instead of shrinking the
+    learning rate by a factor of n."""
+    n = updates.shape[0]
+    t = min(int(trim_frac * n), (n - 1) // 2)
+    return (n - 2 * t) * trimmed_mean(updates, trim_frac)
+
+
+def median_aggregate(updates: jax.Array) -> jax.Array:
+    """Coordinate-wise median, scaled to the sum-aggregation magnitude by
+    the equivalent honest-majority count ⌈n/2⌉ — the β→0.5 limit of the
+    trimmed mean, exposed for completeness."""
+    n = updates.shape[0]
+    med = jnp.median(updates.astype(jnp.float32), axis=0)
+    return ((n + 1) // 2) * med

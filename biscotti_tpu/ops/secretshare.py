@@ -87,11 +87,18 @@ def num_chunks(num_params: int, poly_size: int = POLY_SIZE) -> int:
     return -(-num_params // poly_size)
 
 
-def to_chunks(q: jax.Array, poly_size: int = POLY_SIZE) -> jax.Array:
+def to_chunks(q: jax.Array, poly_size: int = POLY_SIZE,
+              chunk_multiple: int = 1) -> jax.Array:
     """[d] int64 → [C, k] coefficient rows, zero-padded last chunk
-    (ref: kyber.go:712-743)."""
+    (ref: kyber.go:712-743). `chunk_multiple` additionally pads the CHUNK
+    axis up to a multiple — the standard static-shape practice for
+    sharding C over a mesh axis (make_sharded_share_fns requires mesh-size
+    divisibility); zero chunks share/recover as zeros and from_chunks
+    drops them."""
     d = q.shape[0]
     c = num_chunks(d, poly_size)
+    if chunk_multiple > 1:
+        c = -(-c // chunk_multiple) * chunk_multiple
     padded = jnp.zeros((c * poly_size,), q.dtype).at[:d].set(q)
     return padded.reshape(c, poly_size)
 

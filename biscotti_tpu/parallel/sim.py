@@ -65,21 +65,34 @@ def defense_mask(defense: Defense, model: Model, w: jax.Array,
                  roni_threshold: float, num_adversaries: int) -> jax.Array:
     """Verifier-committee accept mask over the round's noised updates —
     shared by the single-chip (vmap) and sharded (shard_map) round steps so
-    the two paths cannot drift."""
+    the two paths cannot drift. TRIMMED_MEAN has no per-update reject (it
+    is an aggregation rule, not a mask — see masked_aggregate), so it
+    accepts all like NONE."""
     n = noised.shape[0]
     if defense == Defense.KRUM:
         return krum_accept_mask(noised, num_adversaries)
+    if defense == Defense.MULTIKRUM:
+        from biscotti_tpu.ops.robust_agg import multikrum_accept_mask
+
+        return multikrum_accept_mask(noised, num_adversaries)
     if defense == Defense.RONI:
         return roni_accept_mask(model, w, noised, x_val, y_val, roni_threshold)
     return jnp.ones((n,), jnp.bool_)
 
 
 def masked_aggregate(mask: jax.Array, deltas: jax.Array, noised: jax.Array,
-                     dp_in_model: bool) -> jax.Array:
+                     dp_in_model: bool, defense: Defense = Defense.KRUM,
+                     trim_fraction: float = 0.35) -> jax.Array:
     """Miner aggregation: sum of accepted RAW deltas (the noised copies exist
     only for verification, ref: SURVEY §2.3 row 21) — except in dp_in_model
-    mode where the noise IS part of the update (ref: honest.go:172-179)."""
+    mode where the noise IS part of the update (ref: honest.go:172-179).
+    Under TRIMMED_MEAN the sum is replaced by the coordinate-wise trimmed
+    aggregate (ops/robust_agg.py); the mask is all-ones there."""
     agg_src = noised if dp_in_model else deltas
+    if defense == Defense.TRIMMED_MEAN:
+        from biscotti_tpu.ops.robust_agg import trimmed_mean_aggregate
+
+        return trimmed_mean_aggregate(agg_src, trim_fraction)
     return jnp.sum(jnp.where(mask[:, None], agg_src, 0.0), axis=0)
 
 
@@ -136,7 +149,9 @@ class Simulator:
                                        donate_argnums=(0, 1))
 
         def round_step(w, stake, it):
-            return self._round_step_jit(w, stake, it, self.x, self.y,
+            return self._round_step_jit(w, stake, it,
+                                        jnp.asarray(self.cfg.seed, jnp.int32),
+                                        self.x, self.y,
                                         self.x_val, self.y_val)
 
         self.round_step = round_step
@@ -186,9 +201,15 @@ class Simulator:
         # array is baked into the HLO as a constant, which at CNN sizes
         # makes the program itself hundreds of MB (the [N, rows, d] peer
         # stack) — slow to compile and over upload limits on remote-compile
-        # setups. As arguments they stay device-resident buffers.
-        def round_step(w, stake, it, x, y, x_val, y_val):
-            rkey = jax.random.fold_in(self.root_key, it)
+        # setups. As arguments they stay device-resident buffers. The SEED
+        # is an argument for the same reason: a baked-in PRNGKey constant
+        # would force a fresh trace+compile per seed, making multi-seed
+        # sweeps (eval_poison --seeds) pay the compile N times.
+        seed_base = jax.random.PRNGKey(0)  # same constant for every sim
+
+        def round_step(w, stake, it, seed, x, y, x_val, y_val):
+            rkey = jax.random.fold_in(jax.random.fold_in(seed_base, seed),
+                                      it)
             ckey, bkey, nkey = jax.random.split(rkey, 3)
             cidx = self._contributors(ckey)
             s = cidx.shape[0]
@@ -208,7 +229,9 @@ class Simulator:
             mask = defense_mask(defense, model, w, noised, x_val,
                                 y_val, cfg.roni_threshold,
                                 default_num_adversaries(s))
-            w_next = w + masked_aggregate(mask, deltas, noised, cfg.dp_in_model)
+            w_next = w + masked_aggregate(mask, deltas, noised,
+                                          cfg.dp_in_model, defense,
+                                          cfg.trim_fraction)
 
             delta_stake = jnp.where(mask, cfg.stake_unit, -cfg.stake_unit)
             stake_next = stake.at[cidx].add(delta_stake)
@@ -243,26 +266,43 @@ class Simulator:
                     break
         return w, stake, logs
 
-    def run_scan(self, num_rounds: Optional[int] = None):
+    def run_scan(self, num_rounds: Optional[int] = None,
+                 seed: Optional[int] = None):
         """Whole training as ONE compiled XLA program (`lax.scan` over
         rounds) — no host in the loop at all. Upper bound of the TPU design;
-        nothing in the reference's architecture can express this."""
+        nothing in the reference's architecture can express this. `seed`
+        overrides cfg.seed without rebuilding the Simulator (it is a traced
+        argument, so multi-seed sweeps reuse one compiled executable)."""
         if num_rounds is None:
             num_rounds = self.cfg.max_iterations
         w, stake = self.init_state()
         step = self._round_step_raw
 
-        @jax.jit
-        def full(w, stake, x, y, x_val, y_val):
-            def body(carry, it):
-                w, stake = carry
-                w, stake, mask, err = step(w, stake, it, x, y, x_val, y_val)
-                return (w, stake), (err, jnp.sum(mask))
+        # cache the jitted scan per run length: a fresh @jax.jit wrapper
+        # each call would empty the in-memory jit cache and re-trace the
+        # whole N-round program per seed, defeating the seed-as-argument
+        # design
+        full = getattr(self, "_scan_cache", {}).get(num_rounds)
+        if full is None:
 
-            return jax.lax.scan(body, (w, stake), jnp.arange(num_rounds))
+            @jax.jit
+            def full(w, stake, seed, x, y, x_val, y_val):
+                def body(carry, it):
+                    w, stake = carry
+                    w, stake, mask, err = step(w, stake, it, seed, x, y,
+                                               x_val, y_val)
+                    return (w, stake), (err, jnp.sum(mask))
 
-        (w, stake), (errs, accepted) = full(w, stake, self.x, self.y,
-                                            self.x_val, self.y_val)
+                return jax.lax.scan(body, (w, stake),
+                                    jnp.arange(num_rounds))
+
+            self._scan_cache = getattr(self, "_scan_cache", {})
+            self._scan_cache[num_rounds] = full
+
+        s = self.cfg.seed if seed is None else seed
+        (w, stake), (errs, accepted) = full(
+            w, stake, jnp.asarray(s, jnp.int32), self.x, self.y,
+            self.x_val, self.y_val)
         return w, stake, np.asarray(errs), np.asarray(accepted)
 
     # ------------------------------------------------------------------ metrics
@@ -273,6 +313,16 @@ class Simulator:
     def attack_rate(self, w) -> float:
         return float(self.model.error_flat(jnp.asarray(w), self.x_attack,
                                            self.y_attack))
+
+    def attack_success_rate(self, w) -> float:
+        """Stricter source→target metric: fraction of attack-source samples
+        predicted as exactly the attack target class (the 1→7 rate;
+        trainer.attack_success_rate analogue — not inflated by benign
+        confusion the way attack_rate's 1−accuracy is)."""
+        target = ds.spec(self.cfg.dataset).attack_target
+        logits = self.model.apply_flat(jnp.asarray(w), self.x_attack)
+        pred = jnp.argmax(logits, axis=-1)
+        return float(jnp.mean((pred == target).astype(jnp.float32)))
 
 
 # ---------------------------------------------------------------- sharded path
@@ -325,9 +375,20 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
                             sim.y_val, cfg.roni_threshold, f)
         pid = jax.lax.axis_index(axis)
         n_loc = deltas.shape[0]
-        local_mask = jax.lax.dynamic_slice_in_dim(mask, pid * n_loc, n_loc)
-        local_agg = masked_aggregate(local_mask, deltas, noised, cfg.dp_in_model)
-        agg = jax.lax.psum(local_agg, axis)
+        if defense == Defense.TRIMMED_MEAN:
+            # order statistics need the FULL peer set: one more all_gather
+            # (of the raw deltas) and the trimmed aggregate is computed
+            # replicated — same collective budget class as Krum's gather
+            src = all_noised if cfg.dp_in_model else jax.lax.all_gather(
+                deltas, axis, tiled=True)
+            agg = masked_aggregate(mask, src, src, cfg.dp_in_model,
+                                   defense, cfg.trim_fraction)
+        else:
+            local_mask = jax.lax.dynamic_slice_in_dim(mask, pid * n_loc,
+                                                      n_loc)
+            local_agg = masked_aggregate(local_mask, deltas, noised,
+                                         cfg.dp_in_model)
+            agg = jax.lax.psum(local_agg, axis)
         w_next = w + agg
         err = model.error_flat(w_next, sim.x_val, sim.y_val)
         return w_next, mask, err

@@ -87,7 +87,10 @@ def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
         if not isinstance(msg_type, str) or not isinstance(meta, dict):
             raise CodecError("malformed header")
         arrays: Dict[str, np.ndarray] = {}
-        mv = memoryview(payload)
+        # toreadonly(): FrameStream may hand us a bytearray-backed frame, and
+        # frombuffer over a writable buffer yields writable views — force the
+        # read-only invariant regardless of the payload's buffer type
+        mv = memoryview(payload).toreadonly()
         off = 4 + hlen
         for desc in header.get("arrays", []):
             dtype = desc["dtype"]

@@ -825,10 +825,13 @@ class PeerAgent:
         # re-verification — duplicate gossip receipts and every catch-up
         # chain pull otherwise re-pay the whole batched check (measured
         # ~2.3 verifications per peer per block at N=100)
-        if blk.hash in self._quorum_ok_hashes:
-            # memo entries are keyed on computed hashes, so a hit proves a
-            # content-identical block (SHA-256 binding) already passed the
-            # batched check; refresh its LRU position
+        if (blk.hash in self._quorum_ok_hashes
+                and blk.hash == blk.compute_hash()):
+            # memo entries are keyed on computed hashes, and the recompute
+            # (one SHA-256, vs the Schnorr batch the memo saves) binds this
+            # block's CONTENT to the claimed hash locally — the hit no
+            # longer relies on consider_block/chain.verify enforcing the
+            # binding downstream; refresh its LRU position
             self._quorum_ok_hashes.pop(blk.hash)
             self._quorum_ok_hashes[blk.hash] = None
             return True
@@ -1080,6 +1083,12 @@ class PeerAgent:
             ])
             if self.cfg.defense == Defense.KRUM and len(pool) > 2:
                 mask = np.asarray(krum_accept_mask(
+                    jnp.asarray(vecs, jnp.float32),
+                    default_num_adversaries(len(pool))))
+            elif self.cfg.defense == Defense.MULTIKRUM and len(pool) > 2:
+                from biscotti_tpu.ops.robust_agg import multikrum_accept_mask
+
+                mask = np.asarray(multikrum_accept_mask(
                     jnp.asarray(vecs, jnp.float32),
                     default_num_adversaries(len(pool))))
             elif self.cfg.defense == Defense.RONI:
@@ -1516,6 +1525,20 @@ class PeerAgent:
                 mat = np.stack([u.delta for u in updates])
                 if cfg.fedsys:
                     agg = mat.mean(axis=0)  # FedSys averages (FedSys/honest.go:311)
+                elif cfg.defense == Defense.TRIMMED_MEAN and len(updates) > 2:
+                    # non-IID-robust aggregation (ops/robust_agg.py):
+                    # deterministic over the sorted update set, so every
+                    # miner computes the identical aggregate and the
+                    # chain-equality oracle holds. Only reachable with
+                    # secure_agg off (config.__post_init__ enforces the
+                    # shares-vs-order-statistics incompatibility).
+                    import jax.numpy as jnp
+
+                    from biscotti_tpu.ops.robust_agg import trimmed_mean_aggregate
+
+                    agg = np.asarray(trimmed_mean_aggregate(
+                        jnp.asarray(mat, jnp.float32), cfg.trim_fraction),
+                        np.float64)
                 else:
                     agg = mat.sum(axis=0)  # Biscotti sums (honest.go:360-375)
                 for u in updates:

@@ -1,0 +1,149 @@
+"""Non-IID-robust aggregation (ops/robust_agg.py): Multi-Krum and
+coordinate-wise trimmed mean — the beyond-reference defenses covering the
+regime where vanilla Krum's closest-neighbour score fails (tight poisoner
+cluster vs spread honest updates; VERDICT r4 weak #4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Defense
+from biscotti_tpu.ops.robust_agg import (
+    median_aggregate,
+    multikrum_accept_mask,
+    multikrum_m,
+    trimmed_mean,
+    trimmed_mean_aggregate,
+)
+
+
+def test_trimmed_mean_known_values():
+    # per coordinate: sort, drop 1 from each end (trim 0.25 of n=5 → t=1)
+    x = jnp.asarray([[10.0, 0.0], [1.0, 1.0], [2.0, 2.0],
+                     [3.0, 3.0], [-50.0, 4.0]])
+    tm = np.asarray(trimmed_mean(x, 0.25))
+    np.testing.assert_allclose(tm, [2.0, 2.0], atol=1e-6)
+
+
+def test_trimmed_mean_outlier_bounded():
+    # one arbitrarily-bad update cannot move the trimmed mean outside the
+    # honest value range (the robustness property a plain mean lacks)
+    rng = np.random.default_rng(0)
+    honest = rng.normal(0.0, 1.0, size=(9, 32)).astype(np.float32)
+    evil = np.full((1, 32), 1e9, np.float32)
+    tm = np.asarray(trimmed_mean(jnp.asarray(np.vstack([honest, evil])), 0.2))
+    assert np.all(tm <= honest.max(axis=0) + 1e-5)
+    assert np.all(tm >= honest.min(axis=0) - 1e-5)
+
+
+def test_trimmed_mean_aggregate_sum_scale():
+    # identical updates: aggregate must equal (n−2t)·update, the magnitude
+    # the reference's Σ-of-accepted aggregation produces for a clean pool
+    x = jnp.tile(jnp.asarray([[1.0, -2.0]]), (10, 1))
+    agg = np.asarray(trimmed_mean_aggregate(x, 0.3))
+    np.testing.assert_allclose(agg, [4.0, -8.0], atol=1e-5)  # n−2t = 4
+
+
+def test_trimmed_mean_degenerate_keeps_one():
+    # trim_frac that would empty the band is clamped to keep ≥1 element
+    x = jnp.asarray([[1.0], [3.0]])
+    tm = np.asarray(trimmed_mean(x, 0.49))
+    np.testing.assert_allclose(tm, [2.0], atol=1e-6)
+
+
+def test_median_aggregate_scale():
+    x = jnp.asarray([[1.0], [2.0], [100.0]])
+    np.testing.assert_allclose(np.asarray(median_aggregate(x)), [4.0],
+                               atol=1e-6)  # ⌈3/2⌉·median = 2·2
+
+
+def test_multikrum_selects_m_lowest():
+    # 6 clustered honest + 2 far outliers; f=2 → m = 8−2−2 = 4 of the
+    # cluster, outliers never selected
+    rng = np.random.default_rng(1)
+    honest = rng.normal(0.0, 0.1, size=(6, 16)).astype(np.float32)
+    far = rng.normal(50.0, 0.1, size=(2, 16)).astype(np.float32)
+    mask = np.asarray(multikrum_accept_mask(
+        jnp.asarray(np.vstack([honest, far])), 2))
+    assert multikrum_m(8, 2) == 4
+    assert mask.sum() == 4
+    assert not mask[6] and not mask[7]
+
+
+def test_tight_poison_cluster_captures_krum_but_not_trimmed_mean():
+    """The dir(0.3) failure mode in miniature: 30% poisoners mutually
+    near-identical and directionally extreme, honest updates spread wide.
+    Krum's accept set is captured by the cluster; the trimmed aggregate
+    stays within the honest coordinate envelope."""
+    from biscotti_tpu.ops.krum import default_num_adversaries, krum_accept_mask
+
+    rng = np.random.default_rng(2)
+    n, d = 20, 64
+    n_poison = 6  # 30%
+    # capture condition (k = n−f−2 = 8 neighbours, cluster supplies 5 of
+    # them): 3·D_cross < 8·D_honest ⇔ offset² ≲ 2.67·spread² — the
+    # attack hides inside the honest spread, exactly the dir(0.3) regime
+    honest = rng.normal(0.0, 2.0, size=(n - n_poison, d))  # non-IID spread
+    poison = np.tile(rng.normal(3.0, 0.01, size=(1, d)), (n_poison, 1)) \
+        + rng.normal(0.0, 0.01, size=(n_poison, d))
+    pool = jnp.asarray(np.vstack([honest, poison]), jnp.float32)
+
+    kmask = np.asarray(krum_accept_mask(pool, default_num_adversaries(n)))
+    assert kmask[n - n_poison:].all(), \
+        "premise: vanilla Krum accepts the tight poison cluster"
+
+    agg = np.asarray(trimmed_mean_aggregate(pool, 0.35))
+    per_kept = agg / (n - 2 * int(0.35 * n))
+    # signed projection onto the +3·1⃗ attack direction: the captured-Krum
+    # aggregate steps ≈(6·3+4·0)/10 = 1.8 toward the poison; the trimmed
+    # aggregate is bounded by honest order statistics and must land well
+    # under half the attack offset
+    krum_agg = np.asarray(pool)[kmask].mean(axis=0)
+    assert per_kept.mean() < 1.5          # < offset/2
+    # at n=20 the kept band is only 6 order statistics, so the asymmetric-
+    # contamination bias is at its worst; the N=100 sweep (s=70, band 22)
+    # is the full-strength demonstration
+    assert per_kept.mean() < 0.75 * krum_agg.mean()
+
+
+def test_config_rejects_trimmed_mean_with_secure_agg():
+    with pytest.raises(ValueError, match="TRIMMED_MEAN"):
+        BiscottiConfig(defense=Defense.TRIMMED_MEAN, secure_agg=True)
+    cfg = BiscottiConfig(defense=Defense.TRIMMED_MEAN, secure_agg=False)
+    assert cfg.trim_fraction == 0.35
+    with pytest.raises(ValueError, match="trim_fraction"):
+        BiscottiConfig(defense=Defense.TRIMMED_MEAN, secure_agg=False,
+                       trim_fraction=0.6)
+
+
+@pytest.mark.parametrize("defense", [Defense.MULTIKRUM, Defense.TRIMMED_MEAN])
+def test_sim_runs_new_defenses(defense):
+    from biscotti_tpu.parallel.sim import Simulator
+
+    cfg = BiscottiConfig(
+        dataset="creditcard", num_nodes=10, poison_fraction=0.3,
+        defense=defense, verification=True,
+        secure_agg=defense != Defense.TRIMMED_MEAN,
+        noising=True, epsilon=1.0, sample_percent=1.0, seed=1,
+    )
+    sim = Simulator(cfg)
+    w, stake, errs, accepted = sim.run_scan(5)
+    assert np.isfinite(errs).all()
+    assert np.isfinite(np.asarray(w)).all()
+    # attack_success_rate is a probability
+    asr = sim.attack_success_rate(w)
+    assert 0.0 <= asr <= 1.0
+
+
+def test_seed_argument_changes_stream_without_rebuild():
+    from biscotti_tpu.parallel.sim import Simulator
+
+    cfg = BiscottiConfig(dataset="creditcard", num_nodes=8,
+                         defense=Defense.KRUM, verification=True,
+                         noising=True, sample_percent=1.0, seed=1)
+    sim = Simulator(cfg)
+    _, _, e1, _ = sim.run_scan(3, seed=1)
+    _, _, e1b, _ = sim.run_scan(3, seed=1)
+    _, _, e2, _ = sim.run_scan(3, seed=2)
+    np.testing.assert_array_equal(e1, e1b)
+    assert not np.array_equal(e1, e2)
