@@ -39,6 +39,17 @@ class Defense(str, enum.Enum):
     RONI = "RONI"
     MULTIKRUM = "MULTIKRUM"
     TRIMMED_MEAN = "TRIMMED_MEAN"
+    # FoolsGold-style mutual-similarity outlier rejection (robust_agg.py):
+    # an accept-mask defense like KRUM, so it composes with secure-agg and
+    # the stake penalty; targets the sybil-shaped attack the reference
+    # ships (near-duplicate poisoned shards) where it separates under
+    # Dirichlet skew that defeats vanilla Krum. Scoring is single-round on
+    # the copies the verifier sees: with committee noising at ε=1.0 and
+    # mnist dims the DP noise masks update geometry and EVERY geometry
+    # defense (this one and Krum alike) degrades toward accept-everyone —
+    # its demonstrated win is the noising-off defense-geometry operating
+    # point (see ops/robust_agg.py OPERATING POINT note)
+    FOOLSGOLD = "FOOLSGOLD"
 
 
 @dataclass

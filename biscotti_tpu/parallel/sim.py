@@ -75,6 +75,10 @@ def defense_mask(defense: Defense, model: Model, w: jax.Array,
         from biscotti_tpu.ops.robust_agg import multikrum_accept_mask
 
         return multikrum_accept_mask(noised, num_adversaries)
+    if defense == Defense.FOOLSGOLD:
+        from biscotti_tpu.ops.robust_agg import foolsgold_accept_mask
+
+        return foolsgold_accept_mask(noised)
     if defense == Defense.RONI:
         return roni_accept_mask(model, w, noised, x_val, y_val, roni_threshold)
     return jnp.ones((n,), jnp.bool_)

@@ -1091,6 +1091,11 @@ class PeerAgent:
                 mask = np.asarray(multikrum_accept_mask(
                     jnp.asarray(vecs, jnp.float32),
                     default_num_adversaries(len(pool))))
+            elif self.cfg.defense == Defense.FOOLSGOLD and len(pool) > 2:
+                from biscotti_tpu.ops.robust_agg import foolsgold_accept_mask
+
+                mask = np.asarray(foolsgold_accept_mask(
+                    jnp.asarray(vecs, jnp.float32)))
             elif self.cfg.defense == Defense.RONI:
                 mask = np.asarray(roni_accept_mask(
                     self.trainer.model,

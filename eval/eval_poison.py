@@ -72,6 +72,17 @@ def main(argv=None) -> int:
                     help="defense the exit-code gate checks against NONE "
                          "(default: first non-NONE in --defenses)")
     ap.add_argument("--trim-fraction", type=float, default=0.35)
+    ap.add_argument("--noising", type=int, default=1,
+                    help="1 = full-protocol sweep (committee DP noising at "
+                         "--epsilon; verifiers judge NOISED copies — the "
+                         "DistSys operating point, ref runEval.sh -ep=1.0). "
+                         "0 = defense-geometry sweep: noising off, the "
+                         "defense sees raw update geometry (the reference's "
+                         "ML-layer poison evals, ml_main_mnist.py, run "
+                         "without the noising protocol). At ε=1.0 and "
+                         "d=7,850 the noise norm is ~14× the update norm, "
+                         "so similarity/distance defenses are largely "
+                         "masked in mode 1 — measured in the artifacts")
     ap.add_argument("--no-gate", action="store_true",
                     help="report-only run: record gate_waived instead of "
                          "gating (REQUIRED for small-n / @dir / "
@@ -114,7 +125,7 @@ def main(argv=None) -> int:
                 poison_fraction=poison, defense=defense,
                 verification=defense != Defense.NONE,
                 secure_agg=defense != Defense.TRIMMED_MEAN,
-                noising=True, epsilon=args.epsilon,
+                noising=bool(args.noising), epsilon=args.epsilon,
                 sample_percent=0.70, seed=seeds[0],
                 trim_fraction=args.trim_fraction,
             )
@@ -165,6 +176,7 @@ def main(argv=None) -> int:
         "experiment": "poison",
         "dataset": args.dataset, "nodes": args.nodes, "rounds": args.rounds,
         "seeds": len(seeds),
+        "noising": bool(args.noising), "epsilon": args.epsilon,
         "defenses": [d.value for d in defenses],
         "trim_fraction": (args.trim_fraction
                           if Defense.TRIMMED_MEAN in defenses else None),

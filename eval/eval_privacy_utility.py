@@ -114,6 +114,19 @@ def main(argv=None) -> int:
             "attack_rate": round(sim.attack_rate(w), 4),
             "mean_accepted": round(float(np.mean(accepted)), 2),
         }
+        if mech == "mcmc13":
+            # chain-health diagnostic: the Trainer's per-peer MCMC
+            # presample records its acceptance rate (dp_noise.
+            # mcmc_presample; ref emcee default in client_obj.py:52) —
+            # the sim path draws exactly from the stationary density, so
+            # this is the live-path number the artifact should carry
+            from biscotti_tpu.models.trainer import Trainer
+
+            tr = Trainer(args.dataset, f"{args.dataset}0",
+                         cfg=cfg.replace(num_nodes=10))
+            row["mcmc_accept_rate"] = (round(tr.noise_accept_rate, 4)
+                                       if tr.noise_accept_rate is not None
+                                       else None)
         rows.append(row)
         print(json.dumps(row))
 

@@ -9,6 +9,9 @@ import pytest
 
 from biscotti_tpu.config import BiscottiConfig, Defense
 from biscotti_tpu.ops.robust_agg import (
+    foolsgold_accept_mask,
+    foolsgold_weights,
+    max_mutual_cosine,
     median_aggregate,
     multikrum_accept_mask,
     multikrum_m,
@@ -106,6 +109,48 @@ def test_tight_poison_cluster_captures_krum_but_not_trimmed_mean():
     assert per_kept.mean() < 0.75 * krum_agg.mean()
 
 
+def test_foolsgold_weights_crush_near_duplicate_sybils():
+    # the paper's regime: sybils are near-duplicates (cos → 1), honest
+    # clients are spread — logit weights drive sybils to ~0
+    rng = np.random.default_rng(3)
+    honest = rng.normal(0.0, 1.0, size=(7, 128)).astype(np.float32)
+    base = rng.normal(0.0, 1.0, size=(1, 128))
+    sybil = np.tile(base, (3, 1)) + rng.normal(0, 0.01, size=(3, 128))
+    w = np.asarray(foolsgold_weights(
+        jnp.asarray(np.vstack([honest, sybil]), jnp.float32)))
+    assert w[7:].max() < 0.1
+    assert w[:7].min() > 0.9
+
+
+def test_foolsgold_mask_rejects_moderately_similar_cluster():
+    # the reference's actual attack shape: poison mutual cos only
+    # moderately elevated (~0.3-0.4) — the MAD outlier mask still
+    # separates where the logit weights saturate
+    rng = np.random.default_rng(4)
+    n, d, n_poison = 70, 512, 21
+    honest = rng.normal(0.0, 1.0, size=(n - n_poison, d))
+    direction = rng.normal(0.0, 1.0, size=(1, d))
+    # poison = shared direction + ~1.5x independent noise -> cos ~ 0.3
+    poison = np.tile(direction, (n_poison, 1)) + \
+        rng.normal(0.0, 1.3, size=(n_poison, d))
+    pool = jnp.asarray(np.vstack([honest, poison]), jnp.float32)
+    v = np.asarray(max_mutual_cosine(pool))
+    assert v[n - n_poison:].min() > v[:n - n_poison].max() - 0.05, \
+        "premise: poison v-statistics sit above honest"
+    mask = np.asarray(foolsgold_accept_mask(pool))
+    assert not mask[n - n_poison:].any(), "all poisoners rejected"
+    assert mask[:n - n_poison].mean() > 0.9, "honest overwhelmingly kept"
+
+
+def test_foolsgold_uniform_round_rejects_nobody():
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(0.0, 1.0, size=(20, 64)), jnp.float32)
+    # iid Gaussian directions: v is tightly distributed; the MAD floor
+    # must keep rejection ~0 (no poison -> no outliers)
+    mask = np.asarray(foolsgold_accept_mask(x))
+    assert mask.mean() >= 0.8
+
+
 def test_config_rejects_trimmed_mean_with_secure_agg():
     with pytest.raises(ValueError, match="TRIMMED_MEAN"):
         BiscottiConfig(defense=Defense.TRIMMED_MEAN, secure_agg=True)
@@ -116,7 +161,8 @@ def test_config_rejects_trimmed_mean_with_secure_agg():
                        trim_fraction=0.6)
 
 
-@pytest.mark.parametrize("defense", [Defense.MULTIKRUM, Defense.TRIMMED_MEAN])
+@pytest.mark.parametrize("defense", [Defense.MULTIKRUM, Defense.TRIMMED_MEAN,
+                                     Defense.FOOLSGOLD])
 def test_sim_runs_new_defenses(defense):
     from biscotti_tpu.parallel.sim import Simulator
 
