@@ -291,3 +291,65 @@ def test_register_peer_chain_omission_gates_on_weight_not_length():
         return True
 
     assert asyncio.run(go())
+
+
+def test_cluster_robust_defenses_live():
+    """The r5 Defense members drive the live protocol end to end:
+    MULTIKRUM and FOOLSGOLD are verifier accept masks (compose with
+    secure-agg), TRIMMED_MEAN replaces the miner's sum aggregation
+    (secure_agg off — config enforces the order-statistics-over-shares
+    incompatibility). Chain-equality oracle for each."""
+    for j, (defense, secagg) in enumerate([
+            (Defense.MULTIKRUM, True),
+            (Defense.FOOLSGOLD, True),
+            (Defense.TRIMMED_MEAN, False)]):
+        n, port = 5, 25010 + 10 * j
+        cfgs = [
+            _cfg(i, n, port, secure_agg=secagg, noising=True,
+                 verification=True, defense=defense, epsilon=1.0,
+                 max_iterations=2)
+            for i in range(n)
+        ]
+        results = _run_cluster(cfgs)
+        dumps = [r["chain_dump"] for r in results]
+        assert all(d == dumps[0] for d in dumps), defense
+        lines = dumps[0].splitlines()
+        assert len(lines) == 3, defense
+        assert "ndeltas=0" not in lines[1], (defense, dumps[0])
+
+
+def test_trimmed_mean_miner_aggregation_is_trimmed():
+    """The minted block's global_w must be the coordinate-wise trimmed
+    aggregate of the carried deltas, not their sum: a single outlier
+    update cannot drag the model (the property the Defense buys)."""
+    import jax.numpy as jnp
+
+    from biscotti_tpu.ops.robust_agg import trimmed_mean_aggregate
+
+    n, port = 5, 25040
+    cfgs = [
+        _cfg(i, n, port, secure_agg=False, noising=False,
+             verification=True, defense=Defense.TRIMMED_MEAN,
+             max_iterations=1)
+        for i in range(n)
+    ]
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    agents, results = asyncio.run(go())
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+    blk = agents[0].chain.blocks[1]
+    carried = [u.delta for u in blk.data.deltas
+               if u.accepted and u.delta is not None and len(u.delta)]
+    assert len(carried) >= 3
+    expect = np.asarray(trimmed_mean_aggregate(
+        jnp.asarray(np.stack(carried), jnp.float32),
+        cfgs[0].trim_fraction), np.float64)
+    got = blk.data.global_w - agents[0].chain.blocks[0].data.global_w
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    # and it is NOT the plain sum (the reference's aggregation)
+    assert not np.allclose(got, np.stack(carried).sum(axis=0))
