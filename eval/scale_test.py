@@ -249,9 +249,31 @@ def main(argv=None) -> int:
     from biscotti_tpu.data.datasets import spec as dspec
 
     mode = "fedsys" if args.fedsys else "biscotti"
+    attack = {}
+    if args.poison > 0:
+        # live-protocol attack accounting: score the CHAIN's final model
+        # (the one every peer converged on — chains_equal asserts it) on
+        # the attack-source split, with both the reference's 1−accuracy
+        # metric and the stricter predicted-as-target rate
+        # (trainer.attack_rate / attack_success_rate)
+        w_final = agents[0].chain.latest_gradient()
+        tr = agents[0].trainer
+        attack = {
+            "poison_fraction": args.poison,
+            "attack_rate": round(tr.attack_rate(w_final), 4),
+            "attack_success_rate": round(
+                tr.attack_success_rate(w_final), 4),
+        }
     summary = {
         "mode": mode, "nodes": args.nodes, "dataset": args.dataset,
         "model": args.model_name or "default",
+        # TRIMMED_MEAN acts at MINER aggregation (peer.py), independent of
+        # the verification flag; mask defenses need verifiers to run
+        "defense": (args.defense
+                    if args.verification or args.defense == "TRIMMED_MEAN"
+                    else "NONE"),
+        "num_verifiers": args.num_verifiers, "num_miners": args.num_miners,
+        "num_noisers": args.num_noisers,
         # all N peers share this host: s/iter here charges every peer's
         # compute+crypto to os.cpu_count() cores, where the reference's
         # fleet numbers (BASELINE.md) spread 100 nodes over ~20 multi-core
@@ -266,6 +288,7 @@ def main(argv=None) -> int:
         "batched_stepper": bool(args.stepper),
         "geo_regions": args.geo_regions,
         "geo_rtt_ms": args.geo_rtt_ms if args.geo_regions > 1 else 0,
+        **attack,
         "iterations_run": n_blocks, "nonempty_blocks": nonempty,
         "chains_equal": equal, "wall_s": round(wall, 2),
         "raw_wall_s": round(raw_wall, 2),
