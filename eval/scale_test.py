@@ -264,6 +264,21 @@ def main(argv=None) -> int:
             "attack_success_rate": round(
                 tr.attack_success_rate(w_final), 4),
         }
+        # stake-decay evidence: the PoS anti-capture mechanism is
+        # "rejected poisoners lose election weight" — record the final
+        # per-group mean stake so the claim is measured, not inferred
+        from biscotti_tpu.parallel.sim import _poisoned_ids
+
+        stake_map = agents[0].chain.latest_stake_map()
+        poisoned = _poisoned_ids(args.nodes, args.poison)
+        p_stakes = [stake_map.get(i, 0) for i in poisoned]
+        h_stakes = [stake_map.get(i, 0) for i in range(args.nodes)
+                    if i not in poisoned]
+        if p_stakes and h_stakes:
+            attack["mean_stake_poisoned"] = round(
+                sum(p_stakes) / len(p_stakes), 1)
+            attack["mean_stake_honest"] = round(
+                sum(h_stakes) / len(h_stakes), 1)
     summary = {
         "mode": mode, "nodes": args.nodes, "dataset": args.dataset,
         "model": args.model_name or "default",
