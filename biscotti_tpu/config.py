@@ -16,6 +16,10 @@ import enum
 import math
 from dataclasses import dataclass, field
 
+# stdlib-only module (hash-derived decisions, breaker state machine): safe
+# to import here without dragging the asyncio runtime into config users
+from biscotti_tpu.runtime.faults import FaultPlan
+
 
 class Defense(str, enum.Enum):
     """Poisoning-defense selection (ref: DistSys/main.go:57 POISON_DEFENSE).
@@ -174,6 +178,22 @@ class BiscottiConfig:
     convergence_error: float = 0.05  # train-error exit threshold
     timeouts: Timeouts = field(default_factory=Timeouts)
 
+    # --- robustness plane (no reference analogue; runtime/faults.py) ---
+    # unicast RPC retry budget: attempts = rpc_retries + 1, sleeps follow
+    # exponential backoff with decorrelated jitter in [base, cap]
+    rpc_retries: int = 2
+    rpc_backoff_base_s: float = 0.05
+    rpc_backoff_cap_s: float = 2.0
+    # per-peer circuit breaker: `threshold` consecutive transport failures
+    # open it; after `cooldown_s` one half-open probe may re-close it
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 5.0
+    # seeded deterministic fault injection over the live RPC transport
+    # (drop/delay/duplicate/reset per frame); default = disabled. The
+    # simulator mirrors the `drop` knob at round granularity (parallel/
+    # sim.py) so degraded-round semantics agree between sim and live.
+    fault_plan: FaultPlan = field(default_factory=FaultPlan)
+
     # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
     learning_rate: float = 1e-3  # torch-path SGD lr (used by optimizer-step modes)
     logreg_alpha: float = 1e-2  # numpy-logreg step size α (ref: logistic_model.py:12)
@@ -322,6 +342,31 @@ class BiscottiConfig:
         p.add_argument("--seed", type=int, default=0)
         p.add_argument("--fedsys", type=int, default=0,
                        help="FedSys leader-aggregation baseline mode")
+        # defaults reference the dataclass/FaultPlan field defaults — the
+        # single source — so CLI and programmatic construction can't drift
+        p.add_argument("--rpc-retries", type=int, default=BiscottiConfig.rpc_retries,
+                       help="extra attempts per unicast RPC on transport "
+                            "failure (exponential backoff + jitter)")
+        p.add_argument("--breaker-threshold", type=int,
+                       default=BiscottiConfig.breaker_threshold,
+                       help="consecutive failures that quarantine a peer")
+        p.add_argument("--breaker-cooldown-s", type=float,
+                       default=BiscottiConfig.breaker_cooldown_s,
+                       help="seconds quarantined before a half-open probe")
+        p.add_argument("--fault-seed", type=int, default=FaultPlan.seed,
+                       help="fault plane seed: same seed = same schedule")
+        p.add_argument("--fault-drop", type=float, default=FaultPlan.drop,
+                       help="P(outbound frame silently lost)")
+        p.add_argument("--fault-delay", type=float, default=FaultPlan.delay,
+                       help="P(outbound frame delayed)")
+        p.add_argument("--fault-delay-s", type=float,
+                       default=FaultPlan.delay_s,
+                       help="max injected per-frame delay, seconds")
+        p.add_argument("--fault-dup", type=float,
+                       default=FaultPlan.duplicate,
+                       help="P(outbound frame written twice)")
+        p.add_argument("--fault-reset", type=float, default=FaultPlan.reset,
+                       help="P(connection torn down instead of writing)")
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
@@ -356,6 +401,21 @@ class BiscottiConfig:
             fail_prob=ns.fail_prob,
             seed=ns.seed,
             fedsys=bool(getattr(ns, "fedsys", 0)),
+            # fallbacks (for hand-built namespaces that skipped add_args)
+            # reference the same field defaults the parser advertises
+            rpc_retries=getattr(ns, "rpc_retries", cls.rpc_retries),
+            breaker_threshold=getattr(ns, "breaker_threshold",
+                                      cls.breaker_threshold),
+            breaker_cooldown_s=getattr(ns, "breaker_cooldown_s",
+                                       cls.breaker_cooldown_s),
+            fault_plan=FaultPlan(
+                seed=getattr(ns, "fault_seed", FaultPlan.seed),
+                drop=getattr(ns, "fault_drop", FaultPlan.drop),
+                delay=getattr(ns, "fault_delay", FaultPlan.delay),
+                delay_s=getattr(ns, "fault_delay_s", FaultPlan.delay_s),
+                duplicate=getattr(ns, "fault_dup", FaultPlan.duplicate),
+                reset=getattr(ns, "fault_reset", FaultPlan.reset),
+            ),
         )
 
     def replace(self, **kw) -> "BiscottiConfig":

@@ -184,6 +184,11 @@ def foolsgold_accept_mask(updates: jax.Array) -> jax.Array:
     v = max_mutual_cosine(updates)
     med = jnp.median(v)
     mad = jnp.median(jnp.abs(v - med))
-    # floor the scale so a perfectly uniform v (mad=0) rejects nobody
-    thresh = med + 3.0 * jnp.maximum(mad, 1e-3)
+    # reject only ABOVE med + max(3·MAD, 0.05): the relative term adapts
+    # to the round's spread, the absolute floor keeps clean-round false
+    # rejects near zero — on a tight honest v-distribution (tiny MAD) the
+    # upper tail of honest clients would otherwise be flagged round after
+    # round and stake-starved for cosine noise far below any real sybil
+    # signal (poison-poison cos ≈ 0.3 vs honest ≈ 0.04; ADVICE r5)
+    thresh = med + jnp.maximum(3.0 * mad, 0.05)
     return v <= thresh

@@ -230,7 +230,7 @@ def make_sharded_share_fns(mesh, axis: str = "chunks",
     C must divide over the mesh axis size. Runs wherever the mesh lives —
     the 8-device virtual CPU mesh in tests; on TPU pods this axis rides
     hosts (int64 — see module docstring on device placement)."""
-    from jax import shard_map
+    from biscotti_tpu.utils.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     _require_x64("make_sharded_share_fns")

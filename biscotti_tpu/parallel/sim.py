@@ -196,6 +196,23 @@ class Simulator:
         batch = cfg.batch_size
         use_noise = cfg.noising or cfg.dp_in_model
         defense = cfg.defense if cfg.verification else Defense.NONE
+        # cheap mirror of the live fault plane (cfg.fault_plan, runtime/
+        # faults.py): with drop probability p, each contributor's round
+        # frame is lost with p — deterministically in (fault seed, it, i),
+        # so same seed ⇒ same degraded rounds here AND in the live runtime
+        # sense (fewer contributors, no stake movement for the lost ones).
+        # Semantics match the live system's dominant drop outcome: the
+        # worker computed and verifiers scored the update (defense_mask
+        # still sees it), but the miner-bound frame died, so it joins no
+        # aggregate and earns no stake. Per-link structure is not modeled
+        # — this is the ROUND-level agreement knob, not a transport sim.
+        drop_p = cfg.fault_plan.drop if cfg.fault_plan.enabled else 0.0
+        if drop_p > 0.0 and defense == Defense.TRIMMED_MEAN:
+            raise ValueError(
+                "fault_plan.drop is not supported with defense=TRIMMED_MEAN "
+                "in the simulator: the trimmed aggregate has no per-update "
+                "mask to carry the drops (run the live runtime for that)")
+        fault_base = jax.random.PRNGKey(cfg.fault_plan.seed)
 
         def one_delta(w, key, xi, yi):
             idx = sample_batch(key, self.rows, batch)
@@ -233,11 +250,16 @@ class Simulator:
             mask = defense_mask(defense, model, w, noised, x_val,
                                 y_val, cfg.roni_threshold,
                                 default_num_adversaries(s))
+            delta_stake = jnp.where(mask, cfg.stake_unit, -cfg.stake_unit)
+            if drop_p > 0.0:
+                dkey = jax.random.fold_in(fault_base, it)
+                keep = jax.random.uniform(dkey, (s,)) >= drop_p
+                mask = mask & keep  # lost frames join no aggregate …
+                delta_stake = jnp.where(keep, delta_stake, 0)  # … or ledger
             w_next = w + masked_aggregate(mask, deltas, noised,
                                           cfg.dp_in_model, defense,
                                           cfg.trim_fraction)
 
-            delta_stake = jnp.where(mask, cfg.stake_unit, -cfg.stake_unit)
             stake_next = stake.at[cidx].add(delta_stake)
 
             err = model.error_flat(w_next, x_val, y_val)
@@ -342,9 +364,17 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
     noised deltas (Krum needs the full set) and one psum of the masked local
     aggregate — the ICI-collective replacement for the reference's
     TCP update fan-out (ref: SURVEY §5.8).
+
+    Randomness derives from the same seed-as-argument scheme as the
+    single-chip round_step — fold_in(fold_in(PRNGKey(0), seed), it) — so
+    `run_step(w, it, seed=...)` overrides behave identically on both paths
+    (previously this path read sim.root_key and seed overrides silently
+    no-opped on sharded runs; ADVICE round 5). The fault plane's drop-mask
+    knob (cfg.fault_plan.drop) is mirrored here too — see _build_round_step.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+
+    from biscotti_tpu.utils.compat import shard_map
 
     cfg = sim.cfg
     model = sim.model
@@ -352,8 +382,11 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
     use_noise = cfg.noising or cfg.dp_in_model
     defense = cfg.defense if cfg.verification else Defense.NONE
     f = default_num_adversaries(n)
+    seed_base = jax.random.PRNGKey(0)  # same constant as _build_round_step
+    drop_p = cfg.fault_plan.drop if cfg.fault_plan.enabled else 0.0
+    fault_base = jax.random.PRNGKey(cfg.fault_plan.seed)
 
-    def local_deltas(w, x_loc, y_loc, it):
+    def local_deltas(w, x_loc, y_loc, it, seed):
         def one(key, xi, yi):
             idx = sample_batch(key, sim.rows, cfg.batch_size)
             return sim._step(w, xi[idx], yi[idx])
@@ -361,7 +394,7 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
         pid = jax.lax.axis_index(axis)
         n_loc = x_loc.shape[0]
         gids = pid * n_loc + jnp.arange(n_loc)
-        rkey = jax.random.fold_in(sim.root_key, it)
+        rkey = jax.random.fold_in(jax.random.fold_in(seed_base, seed), it)
         bkey, nkey = jax.random.split(rkey)
         bkeys = jax.vmap(lambda i: jax.random.fold_in(bkey, i))(gids)
         deltas = jax.vmap(one)(bkeys, x_loc, y_loc)
@@ -372,11 +405,17 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
             noise = jnp.zeros_like(deltas)
         return deltas, deltas + noise
 
-    def sharded_step(w, x_loc, y_loc, it):
-        deltas, noised = local_deltas(w, x_loc, y_loc, it)
+    def sharded_step(w, x_loc, y_loc, it, seed):
+        deltas, noised = local_deltas(w, x_loc, y_loc, it, seed)
         all_noised = jax.lax.all_gather(noised, axis, tiled=True)  # [N, d]
         mask = defense_mask(defense, model, w, all_noised, sim.x_val,
                             sim.y_val, cfg.roni_threshold, f)
+        if drop_p > 0.0:
+            # mirror of the live fault plane's frame drops: the accepted
+            # update whose miner-bound frame is lost contributes nothing
+            # (see _build_round_step for the exact shared semantics)
+            dkey = jax.random.fold_in(fault_base, it)
+            mask = mask & (jax.random.uniform(dkey, (n,)) >= drop_p)
         pid = jax.lax.axis_index(axis)
         n_loc = deltas.shape[0]
         if defense == Defense.TRIMMED_MEAN:
@@ -399,7 +438,7 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
 
     mapped = shard_map(
         sharded_step, mesh=mesh,
-        in_specs=(P(), P(axis), P(axis), P()),
+        in_specs=(P(), P(axis), P(axis), P(), P()),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
@@ -409,8 +448,10 @@ def make_sharded_round_step(sim: Simulator, mesh: jax.sharding.Mesh,
     x_sh = jax.device_put(sim.x, sharding)
     y_sh = jax.device_put(sim.y, sharding)
 
-    def run_step(w, it):
-        return step(w, x_sh, y_sh, jnp.asarray(it))
+    def run_step(w, it, seed: Optional[int] = None):
+        s = sim.cfg.seed if seed is None else seed
+        return step(w, x_sh, y_sh, jnp.asarray(it),
+                    jnp.asarray(s, jnp.int32))
 
     return run_step
 

@@ -7,4 +7,11 @@ peer agent (`peer.py`) over a length-prefixed binary codec (`messages.py`,
 jitted XLA via the Trainer/ops layers. FedSys (the reference's baseline
 system, SURVEY.md §2.5) is the same runtime in leader-aggregation mode —
 a config flag, not a second codebase.
+
+Robustness plane (`faults.py`, docs/FAULT_PLANE.md): a seeded
+deterministic fault injector at the transport boundary (per-frame
+drop/delay/duplicate/reset — same seed ⇒ same schedule), retry with
+decorrelated-jitter backoff in `PeerAgent._call`, and a per-peer
+circuit breaker with half-open probing that quarantines dead peers so
+gossip and committee RPCs stop burning round budget on them.
 """

@@ -44,7 +44,7 @@ class BatchStepper:
     def __init__(self, cfg, mesh, axis: str = "peers"):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from biscotti_tpu.utils.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from biscotti_tpu.data import datasets as ds

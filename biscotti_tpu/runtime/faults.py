@@ -1,0 +1,354 @@
+"""Deterministic fault-injection plane + peer-health robustness primitives.
+
+The reference tests robustness only from the OUTSIDE — shell scripts that
+`fuser -k` random nodes and open 30 s iptables DROP windows (ref:
+DistSys/failAndRestartLocal.sh, blockNode.sh; see tests/test_fault_injection
+docstring). Partial faults — a dropped frame, a slow link, duplicated
+gossip, a mid-round connection reset — were untested and unreproducible.
+This module turns those ad-hoc crash scripts into a seeded chaos plane:
+
+  * `FaultPlan` — a pure function of (seed, src, dst, msg_type, attempt)
+    deciding drop / delay / duplicate / reset for every frame the RPC pool
+    writes. Same seed ⇒ byte-identical fault schedule, so any chaos run is
+    replayable (the determinism contract, docs/FAULT_PLANE.md).
+  * `FaultInjector` — a FaultPlan bound to one agent (src id + address→peer
+    resolution), tallying and optionally recording every decision so tests
+    and artifacts can assert on the schedule itself.
+  * `backoff_schedule` — exponential backoff with decorrelated jitter
+    (retry sleeps for PeerAgent._call); seeded rng ⇒ reproducible schedule.
+  * `HealthLedger` — per-peer consecutive-failure circuit breaker with
+    half-open probing, so gossip fan-out and committee RPCs skip dead
+    peers instead of burning the round budget re-timing-out on them
+    (the retry-with-backoff + peer-health design argued for by Garfield
+    [arXiv:2010.05888] and "Secure Distributed Training at Scale"
+    [arXiv:2106.11257] — fault tolerance in the communication layer).
+
+Injection happens at the sender's `_Conn` boundary (rpc.Pool), so real TCP
+loopback traffic is perturbed — delayed and duplicated frames actually
+cross the wire; dropped frames die before the socket exactly as a lossy
+network would eat them; resets tear the shared multiplexed connection down
+mid-flight. From the caller's perspective a dropped request and a dropped
+reply are the same event (a timeout), so sender-side injection covers both
+directions of the frame exchange.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Circuit-breaker states (str constants, not an Enum: they ride into JSON
+# trace events and health snapshots as-is)
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+
+class CircuitOpenError(ConnectionError):
+    """Fast-fail raised instead of dialing a quarantined peer. Subclasses
+    ConnectionError so every existing transport-failure except-clause
+    (eviction, gather fan-outs, _safe_call) handles it unchanged."""
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    """One frame's fate. Precedence when several faults draw true:
+    reset > drop > (delay, duplicate) — a reset connection can deliver
+    nothing, a dropped frame cannot also arrive twice."""
+
+    drop: bool = False
+    duplicate: bool = False
+    reset: bool = False
+    delay_s: float = 0.0
+
+    @property
+    def benign(self) -> bool:
+        return not (self.drop or self.duplicate or self.reset
+                    or self.delay_s > 0.0)
+
+    def kind(self) -> str:
+        """Compact label for tallies/logs."""
+        if self.reset:
+            return "reset"
+        if self.drop:
+            return "drop"
+        if self.duplicate and self.delay_s > 0:
+            return "delay+dup"
+        if self.duplicate:
+            return "dup"
+        if self.delay_s > 0:
+            return "delay"
+        return "none"
+
+
+_BENIGN = FaultAction()
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """Seeded link-fault configuration (surfaced as cfg.fault_plan).
+
+    Every probability is per-frame and independent: `action()` is a pure
+    function of (seed, src, dst, msg_type, attempt, seq) — no shared RNG
+    state, so concurrent tasks and process restarts all see the same
+    schedule. `seq` is the per-(dst, msg_type) frame ordinal maintained by
+    the FaultInjector: without it, every RegisterBlock gossip post on one
+    link (always attempt 0) would share ONE draw and a 10% drop plan would
+    blackhole ~10% of LINKS for the whole run instead of dropping ~10% of
+    FRAMES on every link. Retries land on a new attempt number (and a new
+    seq) and therefore a fresh draw: a retried frame is not doomed to
+    re-lose forever.
+    """
+
+    seed: int = 0
+    drop: float = 0.0       # P(frame silently lost before the socket)
+    delay: float = 0.0      # P(frame delayed before the write)
+    delay_s: float = 0.05   # max per-frame delay; actual in [½·delay_s, delay_s]
+    duplicate: float = 0.0  # P(frame written twice back-to-back)
+    reset: float = 0.0      # P(connection torn down instead of writing)
+
+    @property
+    def enabled(self) -> bool:
+        return (self.drop > 0.0 or self.delay > 0.0 or self.duplicate > 0.0
+                or self.reset > 0.0)
+
+    def action(self, src: int, dst: int, msg_type: str,
+               attempt: int = 0, seq: int = 0) -> FaultAction:
+        """The deterministic fate of one (src→dst, msg_type, attempt, seq)
+        frame."""
+        if not self.enabled:
+            return _BENIGN
+        h = hashlib.sha256(
+            f"biscotti-fault|{self.seed}|{src}|{dst}|{msg_type}|{attempt}"
+            f"|{seq}".encode()).digest()
+        # five independent uniforms in [0,1) carved from one digest
+        u = [int.from_bytes(h[6 * i: 6 * i + 6], "big") / float(1 << 48)
+             for i in range(5)]
+        if u[0] < self.reset:
+            return FaultAction(reset=True)
+        if u[1] < self.drop:
+            return FaultAction(drop=True)
+        dup = u[2] < self.duplicate
+        d = 0.0
+        if u[3] < self.delay:
+            d = self.delay_s * (0.5 + 0.5 * u[4])
+        if not dup and d == 0.0:
+            return _BENIGN
+        return FaultAction(duplicate=dup, delay_s=d)
+
+
+class FaultInjector:
+    """A FaultPlan bound to one agent: resolves the pool's (host, port)
+    targets back to peer ids and tallies every non-benign decision.
+    Attach to `rpc.Pool.faults`; the pool consults it per frame.
+
+    Maintains the per-(dst, msg_type) frame ordinal `seq` that keys each
+    frame's draw (see FaultPlan.action): repeated frames of the same type
+    on one link — block gossip round after round — each get their own
+    independent fate.
+
+    With `record=True` every decision (including benign ones) is appended
+    to `.log` as (dst, msg_type, attempt, seq, kind) so a test can replay
+    the exact schedule against a fresh plan and assert reproducibility."""
+
+    def __init__(self, plan: FaultPlan, src: int,
+                 peer_of: Callable[[str, int], Optional[int]],
+                 record: bool = False):
+        self.plan = plan
+        self.src = src
+        self._peer_of = peer_of
+        self._seq: Dict[Tuple[int, str], int] = {}
+        self.counts: Dict[str, int] = {}
+        self.log: Optional[List[Tuple[int, str, int, int, str]]] = \
+            [] if record else None
+
+    def action(self, host: str, port: int, msg_type: str,
+               attempt: int = 0) -> FaultAction:
+        dst = self._peer_of(host, port)
+        if dst is None or dst == self.src:
+            return _BENIGN  # unknown target / self-loop: never perturbed
+        key = (dst, msg_type)
+        seq = self._seq.get(key, 0)
+        self._seq[key] = seq + 1
+        act = self.plan.action(self.src, dst, msg_type, attempt, seq)
+        kind = act.kind()
+        if kind != "none":
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+        if self.log is not None:
+            self.log.append((dst, msg_type, attempt, seq, kind))
+        return act
+
+
+def backoff_schedule(rng, base_s: float, cap_s: float):
+    """Generator of retry sleeps: exponential backoff with DECORRELATED
+    jitter (each sleep ~ U[base, 3·previous], capped) — spreads synchronized
+    retry storms apart while keeping the expected growth exponential.
+    `rng` is a `random.Random`; a seeded instance yields a reproducible
+    schedule (asserted by tests — the determinism contract extends to the
+    retry plane)."""
+    prev = base_s
+    while True:
+        prev = min(cap_s, rng.uniform(base_s, prev * 3.0))
+        yield prev
+
+
+@dataclass
+class _PeerHealth:
+    state: str = CLOSED
+    failures: int = 0        # consecutive transport failures
+    opened_at: float = 0.0
+    probing: bool = False    # half-open probe in flight
+    # lifetime counters (exposed via snapshot())
+    opens: int = 0
+    closes: int = 0
+    fast_fails: int = 0
+    successes: int = 0
+    total_failures: int = 0
+
+
+class HealthLedger:
+    """Per-peer consecutive-failure circuit breaker with half-open probing.
+
+    closed --K consecutive failures--> open --cooldown elapses--> half_open
+    half_open: exactly ONE probe call may proceed; its success closes the
+    breaker (failure count reset), its failure re-opens it for another
+    cooldown. Any success in any state closes the breaker — one good RPC
+    is full rehabilitation (the reference's `alive` set, by contrast,
+    evicts on a single timeout and only re-admits on inbound traffic).
+
+    `clock` is injectable so transition tests run on a fake clock.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._peers: Dict[int, _PeerHealth] = {}
+
+    def _h(self, pid: int) -> _PeerHealth:
+        h = self._peers.get(pid)
+        if h is None:
+            h = self._peers[pid] = _PeerHealth()
+        return h
+
+    def state(self, pid: int) -> str:
+        return self._h(pid).state
+
+    def allow(self, pid: int) -> bool:
+        """May a unicast RPC toward `pid` proceed now? Consumes the single
+        half-open probe slot when the cooldown has elapsed; callers that
+        get False should fail fast (CircuitOpenError) without dialing."""
+        h = self._h(pid)
+        if h.state == CLOSED:
+            return True
+        if h.state == OPEN:
+            if self._clock() - h.opened_at >= self.cooldown_s:
+                h.state = HALF_OPEN
+                h.probing = True
+                return True
+            h.fast_fails += 1
+            return False
+        # HALF_OPEN: one probe at a time
+        if h.probing:
+            h.fast_fails += 1
+            return False
+        h.probing = True
+        return True
+
+    def release_probe(self, pid: int) -> None:
+        """Return an UNRESOLVED half-open probe slot (the probe call was
+        cancelled before any outcome was recorded) — without this the slot
+        leaks and the peer stays quarantined until unrelated traffic
+        records an outcome for it. No-op in every other state."""
+        h = self._peers.get(pid)
+        if h is not None and h.state == HALF_OPEN:
+            h.probing = False
+
+    def available(self, pid: int) -> bool:
+        """Non-consuming view for fan-out target selection (gossip): False
+        only while the breaker is open and still cooling down. Does NOT
+        claim the half-open probe slot — a gossip post toward a half-open
+        peer is itself probe-shaped (its failure re-opens the breaker)."""
+        h = self._peers.get(pid)
+        if h is None or h.state != OPEN:
+            return True
+        if self._clock() - h.opened_at >= self.cooldown_s:
+            return True
+        h.fast_fails += 1
+        return False
+
+    def record_success(self, pid: int) -> bool:
+        """One RPC toward `pid` completed (or the peer answered, even with a
+        protocol-level error — the TRANSPORT is healthy). Returns True iff
+        this closed an open/half-open breaker."""
+        h = self._h(pid)
+        was_tripped = h.state != CLOSED
+        h.state = CLOSED
+        h.failures = 0
+        h.probing = False
+        h.successes += 1
+        if was_tripped:
+            h.closes += 1
+        return was_tripped
+
+    def note_inbound(self, pid: int) -> None:
+        """Inbound traffic from `pid` is liveness evidence for the
+        THEM→US path ONLY — it must not touch the outbound failure
+        streak: under an asymmetric partition (their frames reach us,
+        ours die) inbound gossip would otherwise zero the streak every
+        round and the breaker could never open, leaving each outbound
+        RPC to burn its full retry budget. For a TRIPPED breaker it
+        expires the cooldown so the very next outbound call becomes the
+        half-open probe: a genuinely rejoined peer re-closes on that
+        probe's success without waiting out the cooldown, while a
+        one-way-partitioned peer fails the probe and stays quarantined."""
+        h = self._peers.get(pid)
+        if h is None or h.state == CLOSED:
+            return
+        if h.state == OPEN:
+            h.opened_at = self._clock() - self.cooldown_s
+        else:  # HALF_OPEN: free a possibly-stale slot; a fresh probe decides
+            h.probing = False
+
+    def record_failure(self, pid: int) -> bool:
+        """One transport failure (timeout/refused/reset) toward `pid`.
+        Returns True iff this TRIPPED the breaker open."""
+        h = self._h(pid)
+        h.failures += 1
+        h.total_failures += 1
+        if h.state == HALF_OPEN:
+            # the probe itself failed: straight back to open
+            h.state = OPEN
+            h.opened_at = self._clock()
+            h.probing = False
+            h.opens += 1
+            return True
+        if h.state == OPEN:
+            # a failure observed while quarantined (e.g. a fan-out post
+            # that rode available()'s post-cooldown implicit probe): the
+            # peer is demonstrably still dead — RE-ARM the cooldown, or
+            # after the first cooldown the quarantine would never
+            # re-engage for gossip and every round would re-burn rpc_s
+            h.opened_at = self._clock()
+            return False
+        if h.state == CLOSED and h.failures >= self.threshold:
+            h.state = OPEN
+            h.opened_at = self._clock()
+            h.opens += 1
+            return True
+        return False
+
+    def snapshot(self) -> Dict[int, Dict[str, object]]:
+        """Per-peer health for artifacts / assertions (run() result)."""
+        return {
+            pid: {
+                "state": h.state, "failures": h.failures,
+                "opens": h.opens, "closes": h.closes,
+                "fast_fails": h.fast_fails, "successes": h.successes,
+                "total_failures": h.total_failures,
+            }
+            for pid, h in self._peers.items()
+        }

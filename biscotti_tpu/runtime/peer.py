@@ -49,7 +49,8 @@ from biscotti_tpu.models.trainer import Trainer
 from biscotti_tpu.ops import secretshare as ss
 from biscotti_tpu.parallel import roles as R
 from biscotti_tpu.parallel.sim import _poisoned_ids
-from biscotti_tpu.runtime import rpc, wire
+from biscotti_tpu.runtime import faults, rpc, wire
+from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import RPCError, StaleError
 from biscotti_tpu.tools import keygen
 from biscotti_tpu.utils.profiling import PhaseClock
@@ -190,6 +191,10 @@ class PeerAgent:
         # membership: evicted peers stop receiving RPCs but keep their slot
         # in the id space (ref: main.go:1479-1482 — peerLookup never shrinks)
         self.alive: Set[int] = set(self.peers)
+        # reverse address map for _peer_for_addr; kept in sync with the one
+        # mutation site (_h_register_peer address updates)
+        self._addr_to_pid: Dict[Tuple[str, int], int] = {
+            addr: pid for pid, addr in self.peers.items()}
 
         # identity keys: from the dealer when provided, else derived
         # deterministically from (seed, id) so local tests need no keygen
@@ -220,6 +225,18 @@ class PeerAgent:
 
         self.timeouts = cfg.timeouts  # already-scaled instance may be passed
         self.pool = rpc.Pool()  # persistent multiplexed connections
+        # per-peer circuit breaker (consecutive transport failures open it;
+        # half-open probing re-closes it) — quarantined peers fail fast in
+        # _call and are skipped by gossip fan-out instead of burning the
+        # round budget re-timing-out (runtime/faults.py)
+        self.health = faults.HealthLedger(
+            threshold=cfg.breaker_threshold,
+            cooldown_s=cfg.breaker_cooldown_s)
+        if cfg.fault_plan.enabled:
+            # deterministic chaos plane: every outbound frame's fate is a
+            # pure function of (fault seed, src, dst, msg_type, attempt)
+            self.pool.faults = faults.FaultInjector(
+                cfg.fault_plan, self.id, self._peer_for_addr)
         # with a peers file the PORT layout is the dealer's, not
         # base_port+id arithmetic; the bind ADDRESS stays cfg.my_ip — the
         # peers-file entry is how others reach us, which behind NAT is not
@@ -321,23 +338,104 @@ class PeerAgent:
                 valid.add(vid)
         return len(valid) >= max(1, (len(vset) + 1) // 2)
 
+    def _peer_for_addr(self, host: str, port: int) -> Optional[int]:
+        """(host, port) → peer id, for the fault plane's per-link keying —
+        O(1) off the cached reverse map (the fault plane consults this for
+        EVERY outbound frame; a linear scan would be O(N²) comparisons per
+        gossip round on the event loop)."""
+        return self._addr_to_pid.get((host, port))
+
+    def _record_peer_ok(self, peer_id: int) -> None:
+        """One RPC toward `peer_id` proved the transport healthy: reset its
+        failure streak and, if the breaker was tripped, close it."""
+        if self.health.record_success(peer_id):
+            self._trace("breaker_close", peer=peer_id)
+        self.alive.add(peer_id)
+
+    def _record_peer_fail(self, peer_id: int) -> None:
+        if self.health.record_failure(peer_id):
+            self._trace("breaker_open", peer=peer_id)
+
     async def _call(self, peer_id: int, msg_type: str, meta=None, arrays=None,
-                    timeout: Optional[float] = None):
+                    timeout: Optional[float] = None,
+                    retries: Optional[int] = None):
         """RPC with the reference's timeout-evict semantics
-        (ref: main.go:1460-1487)."""
+        (ref: main.go:1460-1487), hardened for partial faults:
+
+        * transport failures (timeout / refused / reset) are RETRIED up to
+          cfg.rpc_retries times with exponential backoff + decorrelated
+          jitter — a single lost frame no longer costs the round its call
+        * protocol replies are FATAL, never retried: RPCError is the
+          callee's answer, StaleError is a signal (triggers catch-up) —
+          both prove the transport healthy and feed the breaker as success
+        * a peer whose breaker is OPEN fails fast with CircuitOpenError
+          (a ConnectionError) without dialing; after the cooldown one
+          half-open probe decides re-admission (runtime/faults.py)
+
+        Each attempt keys a fresh fault-plane draw (the attempt number is
+        part of the schedule), so under injection a retry is a genuinely
+        new frame, not a replay of the same doomed one.
+        """
         host, port = self.peers[peer_id]
-        try:
-            return await self.pool.call(host, port, msg_type, meta, arrays,
-                                        timeout or self.timeouts.rpc_s)
-        except (asyncio.TimeoutError, ConnectionError, OSError):
+        timeout = timeout or self.timeouts.rpc_s
+        if not self.health.allow(peer_id):
+            self._trace("rpc_fast_fail", peer=peer_id)
             self.alive.discard(peer_id)
-            raise
-        except StaleError:
-            # the callee is ahead of us: pull the blocks we're missing in
-            # the background (the reference instead parks the CALLEE,
-            # main.go:1211-1214; pulling heals faster after partitions)
-            self._schedule_catch_up(peer_id)
-            raise
+            raise CircuitOpenError(f"peer {peer_id} quarantined")
+        # if allow() just granted us the HALF-OPEN probe slot, we must hand
+        # it back should this call die before any outcome lands (cancelled,
+        # or a non-transport error like a codec bug) — otherwise the slot
+        # leaks and the peer stays quarantined forever
+        i_am_probe = self.health.state(peer_id) == faults.HALF_OPEN
+        attempts = 1 + (self.cfg.rpc_retries if retries is None else retries)
+        backoff = faults.backoff_schedule(
+            self._rng, self.cfg.rpc_backoff_base_s,
+            self.cfg.rpc_backoff_cap_s)
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if attempt:
+                # re-checked AFTER the backoff sleep: a CONCURRENT call
+                # toward this peer may have tripped the breaker while we
+                # slept — dialing anyway would violate the quarantine
+                if not self.health.allow(peer_id):
+                    break
+                if self.health.state(peer_id) == faults.HALF_OPEN:
+                    i_am_probe = True  # that allow() claimed the slot
+            try:
+                out = await self.pool.call(host, port, msg_type, meta,
+                                           arrays, timeout, attempt=attempt)
+                self._record_peer_ok(peer_id)
+                return out
+            except StaleError:
+                # the callee is ahead of us: pull the blocks we're missing
+                # in the background (the reference instead parks the CALLEE,
+                # main.go:1211-1214; pulling heals faster after partitions)
+                self._record_peer_ok(peer_id)
+                self._schedule_catch_up(peer_id)
+                raise
+            except RPCError:
+                self._record_peer_ok(peer_id)
+                raise
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                last = e
+                self._record_peer_fail(peer_id)
+                if attempt + 1 >= attempts \
+                        or self.health.state(peer_id) != faults.CLOSED:
+                    break  # budget spent, or the breaker tripped mid-loop
+                self._trace("rpc_retry", peer=peer_id, msg=msg_type,
+                            attempt=attempt + 1)
+                await asyncio.sleep(next(backoff))
+            except BaseException:
+                # cancellation, or an error OUTSIDE the transport set (e.g.
+                # a codec bug encoding the payload): no breaker outcome was
+                # recorded, so a held half-open probe slot must be handed
+                # back or the peer stays quarantined indefinitely
+                if i_am_probe:
+                    self.health.release_probe(peer_id)
+                raise
+        self.alive.discard(peer_id)
+        assert last is not None
+        raise last
 
     def _schedule_catch_up(self, pid: int) -> None:
         if getattr(self, "_catching_up", False):
@@ -419,6 +517,14 @@ class PeerAgent:
                 src = int(src)
                 if src in self.peers:
                     self.alive.add(src)
+                    # inbound traffic is liveness evidence for the THEM→US
+                    # path only: it expires a tripped breaker's cooldown so
+                    # our next outbound call probes immediately (a restart's
+                    # announce re-admits without waiting out the cooldown),
+                    # but it must NOT reset the outbound failure streak — an
+                    # asymmetrically partitioned peer (reachable inbound,
+                    # dead outbound) has to stay quarantinable
+                    self.health.note_inbound(src)
             except (TypeError, ValueError):
                 pass
         dispatch = {
@@ -485,6 +591,7 @@ class PeerAgent:
         pid = int(meta["source_id"])
         if "host" in meta and "port" in meta:
             self.peers[pid] = (meta["host"], int(meta["port"]))
+            self._addr_to_pid[self.peers[pid]] = pid
         self.alive.add(pid)
         # omit iff our chain would LOSE fork choice against the caller's
         # claimed key — same (weight, length) rule as maybe_adopt, so an
@@ -597,8 +704,19 @@ class PeerAgent:
         # quiet worker that never calls us back would otherwise drop out of
         # every gossip target draw and strand on its block timer (observed
         # at N=50+ under load). A truly dead target costs one fast failed
-        # dial; a mislabeled live one gets its block.
-        targets = [pid for pid in self.peers if pid != self.id]
+        # dial; a mislabeled live one gets its block. The one exception is
+        # a QUARANTINED peer (breaker open, cooling down): it already
+        # failed `breaker_threshold` consecutive times moments ago, so the
+        # fan-out skips it until a half-open probe — or its own inbound
+        # rejoin traffic — re-admits it.
+        targets = []
+        for pid in self.peers:
+            if pid == self.id:
+                continue
+            if not self.health.available(pid):
+                self._trace("gossip_skip_quarantined", peer=pid)
+                continue
+            targets.append(pid)
         if full:
             from biscotti_tpu.runtime import messages as msgs
 
@@ -610,9 +728,22 @@ class PeerAgent:
                 host, port = self.peers[pid]
                 try:
                     await self.pool.post(host, port, frame,
-                                         timeout=self.timeouts.rpc_s)
+                                         timeout=self.timeouts.rpc_s,
+                                         msg_type="RegisterBlock")
                 except Exception:
                     self.alive.discard(pid)
+                    self._record_peer_fail(pid)
+                else:
+                    # a drained post only proves the OS accepted the bytes
+                    # — a wedged peer's socket buffers still drain fine —
+                    # so it may keep a CLOSED streak clean but must never
+                    # rehabilitate a tripped breaker (that would flap the
+                    # quarantine every gossip round); only a reply-bearing
+                    # _call closes it
+                    if self.health.state(pid) == faults.CLOSED:
+                        self._record_peer_ok(pid)
+                    else:
+                        self.alive.add(pid)
 
             # gossip outlives the round on purpose (stragglers still need
             # the block); _bg_tasks holds the strong ref and the bounded
@@ -1530,17 +1661,26 @@ class PeerAgent:
                 mat = np.stack([u.delta for u in updates])
                 if cfg.fedsys:
                     agg = mat.mean(axis=0)  # FedSys averages (FedSys/honest.go:311)
-                elif cfg.defense == Defense.TRIMMED_MEAN and len(updates) > 2:
+                elif cfg.defense == Defense.TRIMMED_MEAN:
                     # non-IID-robust aggregation (ops/robust_agg.py):
                     # deterministic over the sorted update set, so every
                     # miner computes the identical aggregate and the
                     # chain-equality oracle holds. Only reachable with
                     # secure_agg off (config.__post_init__ enforces the
                     # shares-vs-order-statistics incompatibility).
+                    # Applied for ALL n >= 1 — degraded rounds carrying
+                    # 1–2 updates (exactly what the fault plane produces)
+                    # must not silently lapse to an undefended sum; the
+                    # kernel clamps its trim to keep >= 1 element, so for
+                    # n <= 2 it degenerates to the (sum-scaled) mean,
+                    # traced below for artifact visibility (ADVICE r5).
                     import jax.numpy as jnp
 
                     from biscotti_tpu.ops.robust_agg import trimmed_mean_aggregate
 
+                    if len(updates) <= 2:
+                        self._trace("trimmed_mean_degenerate",
+                                    n=len(updates))
                     agg = np.asarray(trimmed_mean_aggregate(
                         jnp.asarray(mat, jnp.float32), cfg.trim_fraction),
                         np.float64)
@@ -1814,6 +1954,12 @@ class PeerAgent:
             # (ref: main.go:1071-1088) — here returned structured
             "counters": dict(self.counters),
             "phases": self.phases.summary(),
+            # robustness accounting: per-peer breaker states/opens/closes/
+            # fast-fails, and (when the fault plane is armed) the injected
+            # fault tallies — chaos harnesses assert on these
+            "health": self.health.snapshot(),
+            "faults": (dict(self.pool.faults.counts)
+                       if self.pool.faults is not None else {}),
         }
 
 

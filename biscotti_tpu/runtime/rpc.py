@@ -18,6 +18,12 @@ a timed-out call abandons its future while the connection stays usable
 (late replies to abandoned rids are dropped). Connection failure fails all
 in-flight calls on it and redials lazily on next use.
 
+Chaos plane: `Pool.faults` optionally holds a `faults.FaultInjector`; every
+outbound frame (calls AND fire-and-forget posts) then gets a deterministic
+drop/delay/duplicate/reset decision keyed on (src, dst, msg_type, attempt),
+applied at the `_Conn` boundary so real TCP traffic is perturbed (see
+faults.py and docs/FAULT_PLANE.md).
+
 Server side: one asyncio task per connection, frames dispatched to a single
 handler coroutine `handle(msg_type, meta, arrays) -> (meta, arrays)`.
 Handlers may block (e.g. a verifier parking a caller until the round's Krum
@@ -347,22 +353,48 @@ class _Conn:
     def alive(self) -> bool:
         return not self.reader_task.done() and self.stream.alive
 
-    async def _send_parts(self, parts, timeout: float) -> None:
+    async def _send_parts(self, parts, timeout: float, fault=None) -> None:
         """Part-wise bounded write (see _send): each buffer goes to the
         transport as-is — large array payloads ride their memoryviews
-        straight from the codec with no event-loop flattening copy."""
+        straight from the codec with no event-loop flattening copy.
+
+        `fault` (a faults.FaultAction, None when the fault plane is off)
+        perturbs THIS frame at the connection boundary: a reset tears the
+        shared multiplexed connection down mid-flight (all in-flight calls
+        fail, next use redials), a delay holds the frame before the write,
+        a drop consumes it before the socket (the caller's await then times
+        out, exactly as if the network ate it), a duplicate writes the same
+        bytes twice back-to-back (receiver-idempotency exercise)."""
         self.sending += 1
         try:
+            if fault is not None and not fault.benign:
+                if fault.reset:
+                    self.close()
+                    raise ConnectionError("fault injection: connection reset")
+                if fault.delay_s > 0.0:
+                    await asyncio.sleep(fault.delay_s)
+                if fault.drop:
+                    return  # frame lost before the wire
             async with self.write_lock:
+                t0 = asyncio.get_running_loop().time()
                 self.stream.write_parts(parts)
                 await asyncio.wait_for(self.stream.drain(), timeout)
+                if fault is not None and fault.duplicate:
+                    # the duplicate rides the SAME budget as the original:
+                    # a fresh full timeout here would let one faulted frame
+                    # hold the shared write_lock ~2x the bound and push
+                    # every queued sender past its own deadline
+                    left = max(0.001, timeout - (
+                        asyncio.get_running_loop().time() - t0))
+                    self.stream.write_parts(parts)
+                    await asyncio.wait_for(self.stream.drain(), left)
         except (asyncio.TimeoutError, ConnectionError, OSError):
             self.close()
             raise
         finally:
             self.sending -= 1
 
-    async def _send(self, frame: bytes, timeout: float) -> None:
+    async def _send(self, frame: bytes, timeout: float, fault=None) -> None:
         """Bounded write: a peer that stops draining (full receive buffer,
         long GIL hold) must not wedge the write lock forever — on timeout
         the connection is torn down so queued callers fail fast and the
@@ -371,9 +403,9 @@ class _Conn:
         `pending`, so without it a broadcast fanning out past the pool cap
         evicts its own conns MID-DRAIN and silently drops frames — at
         N=100 that lost the minted block for every peer beyond the cap."""
-        await self._send_parts([frame], timeout)
+        await self._send_parts([frame], timeout, fault=fault)
 
-    async def roundtrip(self, msg_type, meta, arrays, timeout):
+    async def roundtrip(self, msg_type, meta, arrays, timeout, fault=None):
         rid = self.next_rid
         self.next_rid += 1
         fut = asyncio.get_running_loop().create_future()
@@ -383,7 +415,7 @@ class _Conn:
         parts = msgs.encode_parts(msg_type, meta2, arrays)
         deadline = asyncio.get_running_loop().time() + timeout
         try:
-            await self._send_parts(parts, timeout)
+            await self._send_parts(parts, timeout, fault=fault)
             remaining = max(0.001, deadline - asyncio.get_running_loop().time())
             return await asyncio.wait_for(fut, remaining)
         finally:
@@ -419,6 +451,11 @@ class Pool:
         # point (ref: global-deploy-eval, multi-DC Azure) by charging each
         # cross-"region" RPC its round-trip here. None = loopback (no-op).
         self.latency = latency
+        # Optional deterministic fault plane (faults.FaultInjector): when
+        # set, every outbound frame's fate — drop/delay/duplicate/reset —
+        # is decided per (src, dst, msg_type, attempt) and applied at the
+        # _Conn boundary so real TCP traffic is perturbed, not mocked.
+        self.faults = None
 
     def _evict(self, exempt: Optional[Tuple[str, int]] = None) -> None:
         # drop dead connections regardless of the cap, then close idle
@@ -470,7 +507,7 @@ class Pool:
     async def call(self, host: str, port: int, msg_type: str,
                    meta: Dict[str, Any] | None = None,
                    arrays: Dict[str, np.ndarray] | None = None,
-                   timeout: float = 120.0):
+                   timeout: float = 120.0, attempt: int = 0):
         # one deadline covers dial + send + reply: dialing must not grant
         # the roundtrip a second full budget
         loop = asyncio.get_running_loop()
@@ -479,10 +516,12 @@ class Pool:
             d = self.latency(host, port)
             if d > 0:  # request + reply each ride the link once
                 await asyncio.sleep(d)
+        fault = (self.faults.action(host, port, msg_type, attempt)
+                 if self.faults is not None else None)
         conn = await self._get(host, port, timeout)
         remaining = max(0.001, deadline - loop.time())
         rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays,
-                                              remaining)
+                                              remaining, fault=fault)
         if rmeta.get("error"):
             if rmeta.get("stale"):
                 raise StaleError(rmeta["error"])
@@ -490,19 +529,24 @@ class Pool:
         return rmeta, rarrays
 
     async def post(self, host: str, port: int, frame: bytes,
-                   timeout: float = 120.0) -> None:
+                   timeout: float = 120.0, msg_type: str = "post",
+                   attempt: int = 0) -> None:
         """Fire-and-forget a PRE-ENCODED frame (rid 0: any reply is dropped
         by the reader). Lets a broadcast encode its payload once and write
         the same bytes to every peer — at N=100 the per-peer re-encode of a
-        multi-MB block was the event loop's dominant cost."""
+        multi-MB block was the event loop's dominant cost. `msg_type` only
+        keys the fault plane's draw (the frame already carries its type)."""
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         if self.latency is not None:
             d = self.latency(host, port)
             if d > 0:
                 await asyncio.sleep(d / 2)  # one-way: no reply to wait for
+        fault = (self.faults.action(host, port, msg_type, attempt)
+                 if self.faults is not None else None)
         conn = await self._get(host, port, timeout)
-        await conn._send(frame, max(0.001, deadline - loop.time()))
+        await conn._send(frame, max(0.001, deadline - loop.time()),
+                         fault=fault)
 
     def close(self) -> None:
         for conn in self._conns.values():

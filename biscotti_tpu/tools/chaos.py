@@ -1,0 +1,126 @@
+"""Chaos harness CLI: run a live loopback cluster under a seeded FaultPlan
+and report whether the protocol held.
+
+The reproducible replacement for the reference's shell chaos
+(failAndRestartLocal.sh / blockNode.sh): every injected fault is a pure
+function of --fault-seed, so a failing run's exact fault schedule can be
+replayed by re-running with the same flags (docs/FAULT_PLANE.md).
+
+    python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 3 \
+        --fault-seed 11 --fault-drop 0.10 --fault-delay 0.25 --fault-delay-s 0.05
+
+Exit code 0 iff all peers finished with an equal settled chain prefix and
+at least one real (non-empty) block survived. The JSON report carries the
+per-peer fault tallies, retry/breaker counters, and health snapshots —
+the same accounting the pytest chaos suite asserts on
+(`pytest -m chaos` runs the checked-in matrix).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from typing import Dict, Tuple
+
+
+def chain_oracle(results) -> Tuple[bool, int, int]:
+    """The settled-prefix chain-equality oracle, shared by this CLI and
+    the pytest chaos suite (tests/test_faults.py) so there is ONE
+    definition of "the protocol held". Each peer's last block may still
+    be in flight when it exits, so equality is judged over the common
+    settled prefix. Returns (prefix_equal, settled_height, real_blocks)
+    where real_blocks counts settled non-empty blocks — a run whose every
+    surviving block is empty carries no training signal and must fail."""
+    dumps = [r["chain_dump"].splitlines() for r in results]
+    common = min(len(d) for d in dumps) - 1
+    prefix_equal = all(d[:common] == dumps[0][:common] for d in dumps)
+    real_blocks = sum("ndeltas=0" not in ln for ln in dumps[0][1:common])
+    return prefix_equal, common, real_blocks
+
+
+def tally_faults(results) -> Dict[str, int]:
+    """Sum the per-peer injected-fault counters across a cluster run."""
+    fired: Dict[str, int] = {}
+    for r in results:
+        for k, v in r["faults"].items():
+            fired[k] = fired.get(k, 0) + v
+    return fired
+
+
+def main(argv=None) -> int:
+    from biscotti_tpu.config import BiscottiConfig, Timeouts
+
+    ap = argparse.ArgumentParser(description="seeded chaos cluster run")
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--base-port", type=int, default=26100)
+    ap.add_argument("--dataset", default="creditcard")
+    ap.add_argument("--secure-agg", type=int, default=0)
+    ap.add_argument("--verification", type=int, default=0)
+    ap.add_argument("--fault-seed", type=int, default=0)
+    ap.add_argument("--fault-drop", type=float, default=0.0)
+    ap.add_argument("--fault-delay", type=float, default=0.0)
+    ap.add_argument("--fault-delay-s", type=float, default=0.05)
+    ap.add_argument("--fault-dup", type=float, default=0.0)
+    ap.add_argument("--fault-reset", type=float, default=0.0)
+    ap.add_argument("--rpc-retries", type=int, default=2)
+    ap.add_argument("--breaker-threshold", type=int, default=3)
+    ap.add_argument("--breaker-cooldown-s", type=float, default=2.0)
+    ns = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from biscotti_tpu.runtime.faults import FaultPlan
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
+                     delay=ns.fault_delay, delay_s=ns.fault_delay_s,
+                     duplicate=ns.fault_dup, reset=ns.fault_reset)
+    fast = Timeouts(update_s=4.0, block_s=12.0, krum_s=3.0, share_s=4.0,
+                    rpc_s=4.0)
+
+    def cfg(i):
+        return BiscottiConfig(
+            node_id=i, num_nodes=ns.nodes, dataset=ns.dataset,
+            base_port=ns.base_port, num_verifiers=1, num_miners=1,
+            num_noisers=1, secure_agg=bool(ns.secure_agg), noising=False,
+            verification=bool(ns.verification),
+            max_iterations=ns.rounds, convergence_error=0.0,
+            sample_percent=1.0, batch_size=8, timeouts=fast,
+            rpc_retries=ns.rpc_retries,
+            breaker_threshold=ns.breaker_threshold,
+            breaker_cooldown_s=ns.breaker_cooldown_s, fault_plan=plan)
+
+    async def go():
+        agents = [PeerAgent(cfg(i)) for i in range(ns.nodes)]
+        return await asyncio.gather(*(a.run() for a in agents))
+
+    results = asyncio.run(go())
+    prefix_equal, common, real_blocks = chain_oracle(results)
+    faults_fired = tally_faults(results)
+    report = {
+        "nodes": ns.nodes, "rounds": ns.rounds,
+        "fault_plan": {"seed": plan.seed, "drop": plan.drop,
+                       "delay": plan.delay, "delay_s": plan.delay_s,
+                       "duplicate": plan.duplicate, "reset": plan.reset},
+        "settled_prefix_equal": prefix_equal,
+        "settled_height": common,
+        "real_blocks": real_blocks,
+        "faults_injected": faults_fired,
+        "rpc_retries": sum(r["counters"].get("rpc_retry", 0)
+                           for r in results),
+        "breaker_opens": sum(r["counters"].get("breaker_open", 0)
+                             for r in results),
+        "per_node": [{"node": r["node"], "iterations": r["iterations"],
+                      "faults": r["faults"], "health": r["health"]}
+                     for r in results],
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if prefix_equal and real_blocks >= 1 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""Version-compat shims for the jax API surface this repo spans.
+
+The sharded paths (parallel/sim.py, runtime/device_cluster.py,
+ops/secretshare.py) target the modern top-level `jax.shard_map` with its
+`check_vma` knob; older jax releases (< 0.6) ship the same functionality as
+`jax.experimental.shard_map.shard_map` with the knob spelled `check_rep`.
+Route every call through here so a version bump is one edit, not three.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """`jax.shard_map` where available, else the experimental spelling with
+    `check_vma` mapped onto its older name `check_rep`."""
+    try:
+        from jax import shard_map as _sm
+        kw = {} if check_vma is None else {"check_vma": bool(check_vma)}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        kw = {} if check_vma is None else {"check_rep": bool(check_vma)}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

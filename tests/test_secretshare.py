@@ -142,6 +142,8 @@ def test_share_pipeline_roundtrip_property():
     # property: for ANY quantized vector within the protocol's magnitude
     # range and ANY miner count, recover(aggregate(shares of P peers))
     # equals the exact integer sum of the peers' vectors
+    hypothesis = pytest.importorskip(
+        "hypothesis", reason="property-based deps absent in this env")
     from hypothesis import given, settings, strategies as st
 
     @settings(max_examples=30, deadline=None)
@@ -170,6 +172,8 @@ def test_share_pipeline_roundtrip_property():
 def test_miner_row_slices_partition_the_share_matrix():
     # property: the per-miner row slices tile [0, total_shares) exactly —
     # no overlap, no gap — for every miner count
+    hypothesis = pytest.importorskip(
+        "hypothesis", reason="property-based deps absent in this env")
     from hypothesis import given, settings, strategies as st
 
     @settings(max_examples=50, deadline=None)
