@@ -67,7 +67,7 @@ def _progress(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def bench_config(name, cfg, device_iters=10):
+def bench_config(name, cfg, device_iters=10, metrics=None):
     import jax
     import numpy as np
 
@@ -76,6 +76,9 @@ def bench_config(name, cfg, device_iters=10):
     from biscotti_tpu.parallel.sim import Simulator
 
     _progress(f"{name}: building simulator")
+    # NB: bench drives round_step() directly, so the registry feeds the
+    # bench-level biscotti_bench_* families below, not Simulator.run()'s
+    # per-round instrumentation (that is the sim CLI's --metrics-out)
     sim = Simulator(cfg)
     w, stake = sim.init_state()
     _progress(f"{name}: compiling device round")
@@ -181,6 +184,20 @@ def bench_config(name, cfg, device_iters=10):
         total = device_s + commit_s * (1 + cfg.num_samples)
 
     row["round_total_s"] = round(total, 4)
+    if metrics is not None:
+        # every component lands on the telemetry plane too, as one
+        # histogram family labeled (config, phase) — rendered to
+        # eval/results/bench_metrics.prom at the end of the run
+        hist = metrics.histogram("biscotti_bench_phase_seconds",
+                                 "bench critical-path component times")
+        for phase_key, src in (("device_round", "device_round_s"),
+                               ("worker_crypto", "worker_crypto_s"),
+                               ("miner_crypto", "miner_crypto_s"),
+                               ("recovery", "recovery_s")):
+            if src in row:
+                hist.observe(row[src], config=name, phase=phase_key)
+        metrics.gauge("biscotti_bench_round_total_seconds",
+                      "bench crypto-inclusive s/iter").set(total, config=name)
     _progress(f"{name}: total {total:.3f}s/iter")
     return name, row, total
 
@@ -231,12 +248,16 @@ def main():
             defense=Defense.KRUM, **base)),
     ]
 
+    from biscotti_tpu.telemetry import MetricsRegistry
+
+    registry = MetricsRegistry(max_label_sets=256)  # 8 configs × phases
     rows = {}
     headline_total = None
     for name, cfg in configs:
         iters = 4 if cfg.model_name else 10  # CNN/svm rows: fewer reps
         try:
-            name, row, total = bench_config(name, cfg, device_iters=iters)
+            name, row, total = bench_config(name, cfg, device_iters=iters,
+                                            metrics=registry)
         except Exception as e:  # a config must never sink the whole bench
             rows[name] = {"error": f"{type(e).__name__}: {e}"}
             continue
@@ -269,6 +290,12 @@ def main():
         with open(detail_path, "w") as f:
             json.dump(detail, f, indent=1)
         _progress(f"per-config detail written to {detail_path}")
+        # the same numbers in Prometheus text form, for dashboard ingest
+        prom_path = os.path.join(os.path.dirname(detail_path),
+                                 "bench_metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(registry.render())
+        _progress(f"telemetry page written to {prom_path}")
     except OSError as e:
         _progress(f"could not write detail file: {e}")
     print(json.dumps(detail), file=sys.stderr, flush=True)

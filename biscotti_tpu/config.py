@@ -194,6 +194,20 @@ class BiscottiConfig:
     # sim.py) so degraded-round semantics agree between sim and live.
     fault_plan: FaultPlan = field(default_factory=FaultPlan)
 
+    # --- telemetry plane (biscotti_tpu/telemetry, docs/OBSERVABILITY.md) ---
+    # telemetry=False swaps in no-op registry/recorder singletons: spans
+    # still feed the legacy PhaseClock totals (pre-telemetry cost), all
+    # NEW instrumentation compiles down to nothing
+    telemetry: bool = True
+    # >0: each peer also serves Prometheus text over HTTP on
+    # metrics_port + node_id (same +id layout as base_port); 0 = RPC-only
+    # exposition (the `Metrics` method is always available)
+    metrics_port: int = 0
+    # flight-recorder ring capacity (events) and spill batch size (events
+    # buffered per JSONL write; flush happens at round end and shutdown)
+    recorder_ring: int = 4096
+    recorder_batch: int = 256
+
     # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
     learning_rate: float = 1e-3  # torch-path SGD lr (used by optimizer-step modes)
     logreg_alpha: float = 1e-2  # numpy-logreg step size α (ref: logistic_model.py:12)
@@ -367,6 +381,20 @@ class BiscottiConfig:
                        help="P(outbound frame written twice)")
         p.add_argument("--fault-reset", type=float, default=FaultPlan.reset,
                        help="P(connection torn down instead of writing)")
+        p.add_argument("--telemetry", type=int,
+                       default=int(BiscottiConfig.telemetry),
+                       help="0 disables the metrics registry + flight "
+                            "recorder (instrumentation becomes no-ops)")
+        p.add_argument("--metrics-port", type=int,
+                       default=BiscottiConfig.metrics_port,
+                       help="serve Prometheus text over HTTP on "
+                            "metrics_port + node_id (0 = RPC-only)")
+        p.add_argument("--recorder-ring", type=int,
+                       default=BiscottiConfig.recorder_ring,
+                       help="flight-recorder ring capacity, events")
+        p.add_argument("--recorder-batch", type=int,
+                       default=BiscottiConfig.recorder_batch,
+                       help="events buffered per batched JSONL write")
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
@@ -408,6 +436,10 @@ class BiscottiConfig:
                                       cls.breaker_threshold),
             breaker_cooldown_s=getattr(ns, "breaker_cooldown_s",
                                        cls.breaker_cooldown_s),
+            telemetry=bool(getattr(ns, "telemetry", cls.telemetry)),
+            metrics_port=getattr(ns, "metrics_port", cls.metrics_port),
+            recorder_ring=getattr(ns, "recorder_ring", cls.recorder_ring),
+            recorder_batch=getattr(ns, "recorder_batch", cls.recorder_batch),
             fault_plan=FaultPlan(
                 seed=getattr(ns, "fault_seed", FaultPlan.seed),
                 drop=getattr(ns, "fault_drop", FaultPlan.drop),

@@ -131,6 +131,12 @@ class Trainer:
         if seed is None:
             seed = zlib.crc32(shard.encode())
         self.seed = seed
+        # optional telemetry registry (telemetry.MetricsRegistry), armed
+        # by the embedding runtime: SGD steps and DP noise draws are
+        # counted so cluster scrapes can attribute compute to peers.
+        # Thread-safe (registry locks internally) — private_fun runs off
+        # the event loop via asyncio.to_thread.
+        self.metrics = None
 
         shard_data = ds.load_shard(dataset, shard)
         test = ds.load_shard(dataset, f"{dataset}_test")
@@ -179,6 +185,9 @@ class Trainer:
         return np.zeros(self.num_params, dtype=np.float64)
 
     def private_fun(self, flat_w: np.ndarray, iteration: int) -> np.ndarray:
+        if self.metrics is not None:
+            self.metrics.counter("biscotti_trainer_steps_total",
+                                 "local SGD steps computed").inc()
         return np.asarray(
             self._private(jnp.asarray(flat_w, jnp.float32), iteration,
                           self.x_train, self.y_train, self._batch_key,
@@ -188,6 +197,9 @@ class Trainer:
         )
 
     def get_noise(self, iteration: int) -> np.ndarray:
+        if self.metrics is not None:
+            self.metrics.counter("biscotti_noise_draws_total",
+                                 "DP noise vectors served/consumed").inc()
         alpha = self.cfg.logreg_alpha if self.mode == "sgd" else 1.0
         return np.asarray(
             dp_noise.noise_at(self.noise_samples, iteration, self.batch_size, alpha),

@@ -112,8 +112,14 @@ def _poisoned_ids(num_nodes: int, poison_fraction: float) -> set:
 class Simulator:
     """N peers on one chip (vmapped) or across a mesh (shard_map)."""
 
-    def __init__(self, cfg: BiscottiConfig, model: Optional[Model] = None):
+    def __init__(self, cfg: BiscottiConfig, model: Optional[Model] = None,
+                 metrics=None):
         self.cfg = cfg
+        # optional telemetry registry (telemetry.MetricsRegistry): run()
+        # then feeds a per-round duration histogram and height/error
+        # gauges — the simulator's rounds land on the same scrapeable
+        # plane as the live runtime's (the CLI's --metrics-out wires this)
+        self.metrics = metrics
         self.model = model or model_for_dataset(
             cfg.dataset, getattr(cfg, "model_name", ""))
         self.mode = "sgd" if self.model.name == "logreg" else "grad"
@@ -283,11 +289,23 @@ class Simulator:
             num_rounds = self.cfg.max_iterations
         w, stake = self.init_state()
         logs: List[RoundLog] = []
+        m = self.metrics
         for it in range(num_rounds):
+            t0 = time.perf_counter()
             w, stake, mask, err = self.round_step(w, stake, it)
+            if m is not None:
+                jax.block_until_ready(w)  # charge the round its device time
+                m.histogram("biscotti_sim_round_seconds",
+                            "simulator device-round wall clock").observe(
+                    time.perf_counter() - t0)
+                m.gauge("biscotti_sim_round_height",
+                        "simulator rounds completed").set(it + 1)
             if it % log_every == 0 or it == num_rounds - 1:
                 e = float(err)
                 logs.append(RoundLog(it, e, time.time(), int(mask.sum())))
+                if m is not None:
+                    m.gauge("biscotti_sim_error",
+                            "simulator latest test error").set(e)
                 if stop_at_convergence and e < self.cfg.convergence_error:
                     break
         return w, stake, logs
@@ -477,9 +495,22 @@ def main(argv=None) -> int:
                     help="compile the WHOLE training run as one XLA program")
     ap.add_argument("--csv", default="",
                     help="write iteration,error,timestamp rows here")
+    ap.add_argument("--metrics-out", default="",
+                    help="write a Prometheus text page of the run's "
+                         "telemetry (round histogram, height/error gauges) "
+                         "here; non-scan runs only")
     ns = ap.parse_args(argv)
+    if ns.metrics_out and ns.scan:
+        ap.error("--metrics-out requires a non-scan run (run_scan compiles "
+                 "the whole training into one XLA program; there are no "
+                 "per-round host observations to export)")
     cfg = BiscottiConfig.from_args(ns)
-    sim = Simulator(cfg)
+    registry = None
+    if ns.metrics_out:
+        from biscotti_tpu.telemetry import MetricsRegistry
+
+        registry = MetricsRegistry()
+    sim = Simulator(cfg, metrics=registry)
     rounds = ns.rounds or cfg.max_iterations
     if ns.scan:
         w, stake, errs, accepted = sim.run_scan(rounds)
@@ -490,6 +521,9 @@ def main(argv=None) -> int:
     if ns.csv:
         with open(ns.csv, "w") as f:
             f.write("\n".join(l.csv() for l in logs) + "\n")
+    if registry is not None:
+        with open(ns.metrics_out, "w") as f:
+            f.write(registry.render())
     summary = {
         "dataset": cfg.dataset, "nodes": cfg.num_nodes,
         "rounds_run": len(logs),

@@ -161,6 +161,11 @@ class FaultInjector:
         self._peer_of = peer_of
         self._seq: Dict[Tuple[int, str], int] = {}
         self.counts: Dict[str, int] = {}
+        # optional telemetry registry (telemetry.MetricsRegistry): armed
+        # by the peer agent so injected-fault tallies ride the same
+        # scrapeable plane as everything else; `counts` stays as the
+        # in-process back-compat view
+        self.metrics = None
         self.log: Optional[List[Tuple[int, str, int, int, str]]] = \
             [] if record else None
 
@@ -176,6 +181,11 @@ class FaultInjector:
         kind = act.kind()
         if kind != "none":
             self.counts[kind] = self.counts.get(kind, 0) + 1
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "biscotti_faults_injected_total",
+                    "frames perturbed by the seeded fault plane").inc(
+                    kind=kind, msg_type=msg_type)
         if self.log is not None:
             self.log.append((dst, msg_type, attempt, seq, kind))
         return act

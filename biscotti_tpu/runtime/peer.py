@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
-import json
 import os
 import random
 import time
@@ -52,8 +51,8 @@ from biscotti_tpu.parallel.sim import _poisoned_ids
 from biscotti_tpu.runtime import faults, rpc, wire
 from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import RPCError, StaleError
+from biscotti_tpu.telemetry import Telemetry, serve_metrics
 from biscotti_tpu.tools import keygen
-from biscotti_tpu.utils.profiling import PhaseClock
 
 
 # keyless-mode derived keypairs, cached module-wide: in-process clusters
@@ -252,11 +251,28 @@ class PeerAgent:
         # scraping (ref: the reference prints attack counters at exit,
         # main.go:1071-1088)
         self.counters: Dict[str, int] = {}
+        # unified telemetry plane (biscotti_tpu/telemetry): metrics
+        # registry + round-correlated spans + flight recorder. The old
+        # per-event write()+flush() JSONL log (`log_path`) becomes the
+        # recorder's batched spill; the old ad-hoc PhaseClock lives inside
+        # Telemetry and still backs run()'s legacy `phases` key.
+        self.tele = Telemetry(node=self.id, enabled=cfg.telemetry,
+                              ring=cfg.recorder_ring, spill_path=log_path,
+                              spill_batch=cfg.recorder_batch,
+                              # per-peer labels (biscotti_breaker_state)
+                              # must fit the whole cluster before the
+                              # cardinality cap starts collapsing series
+                              max_label_sets=max(256, 4 * cfg.num_nodes))
         # per-phase wall-clock accounting (SURVEY §5.1): totals come back
         # in run()'s result; eval/eval_cost_breakdown.py aggregates them
-        self.phases = PhaseClock()
-        self._log_path = log_path
-        self._events = open(log_path, "a") if log_path else None
+        self.phases = self.tele.phases
+        if cfg.telemetry:
+            # transport + fault-plane instrumentation share the registry
+            self.pool.metrics = self.tele.registry
+            if self.pool.faults is not None:
+                self.pool.faults.metrics = self.tele.registry
+            self.trainer.metrics = self.tele.registry
+        self._metrics_server = None
         self._rng = random.Random(cfg.seed * 7919 + self.id)
         # strong refs to fire-and-forget tasks: the loop only keeps weak
         # references, so an unreferenced parked task can be GC'd mid-sleep
@@ -277,13 +293,84 @@ class PeerAgent:
 
     def _trace(self, event: str, **kw) -> None:
         """Structured per-round event log (SURVEY.md §5.1: the TPU build's
-        replacement for the reference's timestamped text logs)."""
+        replacement for the reference's timestamped text logs). Events go
+        to the flight recorder — in-memory ring + BATCHED JSONL spill with
+        (wall, monotonic, seq) stamps — not straight to disk: the old
+        per-event write()+flush() was two syscalls on the hot path for
+        every gossip receipt and share intake. The recorder is flushed at
+        round end and on shutdown/crash (telemetry/recorder.py)."""
         self.counters[event] = self.counters.get(event, 0) + 1
-        if self._events:
-            rec = {"ts": time.time(), "node": self.id,
-                   "iter": self.iteration, "event": event, **kw}
-            self._events.write(json.dumps(rec) + "\n")
-            self._events.flush()
+        self.tele.event(event, it=self.iteration, **kw)
+
+    # ----------------------------------------------------------- telemetry
+
+    _BREAKER_LEVEL = {faults.CLOSED: 0, faults.HALF_OPEN: 1, faults.OPEN: 2}
+
+    def _refresh_gauges(self) -> None:
+        """Pull-model gauges, recomputed at scrape time (Metrics RPC /
+        HTTP exposition / run() result) rather than pushed on the hot
+        path: round height, liveness, and per-peer breaker state."""
+        if not self.tele.enabled:
+            return
+        reg = self.tele.registry
+        reg.gauge("biscotti_round_height",
+                  "blockchain iteration this peer is at").set(self.iteration)
+        reg.gauge("biscotti_converged",
+                  "1 once the convergence threshold was met").set(
+            int(self.converged))
+        reg.gauge("biscotti_alive_peers",
+                  "peers currently in the gossip liveness set").set(
+            len(self.alive))
+        breaker = reg.gauge(
+            "biscotti_breaker_state",
+            "per-peer circuit breaker: 0 closed, 1 half-open, 2 open")
+        for pid, h in self.health.snapshot().items():
+            breaker.set(self._BREAKER_LEVEL.get(h["state"], 2), peer=pid)
+
+    def telemetry_snapshot(self) -> Dict:
+        """THE public observability readout — one structured dict serving
+        the `Metrics` RPC, the run() result's `telemetry` key, the chaos
+        CLI, and the test suites (which used to reach into
+        `pool.faults.counts` and private peer dicts; docs/OBSERVABILITY.md
+        documents the schema). JSON-clean: label keys are strings."""
+        self._refresh_gauges()
+        return {
+            "node": self.id,
+            "iter": self.iteration,
+            "converged": self.converged,
+            "counters": dict(self.counters),
+            "phases": self.phases.summary(),
+            "health": {str(p): dict(v)
+                       for p, v in self.health.snapshot().items()},
+            "faults": (dict(self.pool.faults.counts)
+                       if self.pool.faults is not None else {}),
+            "metrics": self.tele.registry.snapshot(),
+            # the recorder may be real even with telemetry disabled (an
+            # explicit spill path keeps the event log alive) — report
+            # whatever it actually holds
+            "recorder": {"events": getattr(self.tele.recorder, "_seq", 0),
+                         "wrapped": self.tele.recorder.wrapped},
+        }
+
+    async def _h_metrics(self, meta, arrays):
+        """Live exposition over the protocol transport: any peer (or the
+        `tools.obs` scraper) can pull this node's Prometheus text + the
+        structured snapshot mid-run; `{"tail": n}` additionally returns
+        the newest n flight-recorder events. Read-only — safe for any
+        caller (it reveals nothing an observer of the gossip plane could
+        not already infer)."""
+        reply = {"snapshot": self.telemetry_snapshot(),
+                 "prom": self.tele.render()}
+        tail = int(meta.get("tail", 0) or 0)
+        if tail > 0:
+            # the recorder tolerates unserializable field values (its
+            # spill uses default=str) but the wire codec is strict JSON —
+            # sanitize the same way before the events enter the reply
+            import json as _json
+
+            reply["events"] = _json.loads(_json.dumps(
+                self.tele.recorder.tail(min(tail, 1000)), default=str))
+        return reply, {}
 
     def _sign(self, message: bytes) -> bytes:
         return cm.schnorr_sign(self.schnorr_seed, message)
@@ -540,6 +627,7 @@ class PeerAgent:
             "VerifyUpdateRONI": self._h_verify_update,
             "GetUpdateList": self._h_get_update_list,
             "GetMinerPart": self._h_get_miner_part,
+            "Metrics": self._h_metrics,
         }
         h = dispatch.get(msg_type)
         if h is None:
@@ -1050,7 +1138,7 @@ class PeerAgent:
                 if not pending:
                     st.miner_vss.clear()
                     return
-                with self.phases.phase("miner_verify"):
+                with self.tele.span("miner_verify", it=st.iteration):
                     ok = await asyncio.to_thread(
                         cm.vss_verify_multi, list(pending.values()))
                 if ok:
@@ -1106,7 +1194,7 @@ class PeerAgent:
                 st.miner_vss_batch.pop(sid, None)
                 return False
             insts[sid] = (rec[0], xs, rows, rec[1])
-        with self.phases.phase("miner_verify"):
+        with self.tele.span("miner_verify", it=st.iteration):
             ok = await asyncio.to_thread(cm.vss_verify_multi,
                                          list(insts.values()))
         if ok:
@@ -1335,7 +1423,7 @@ class PeerAgent:
         w = self.chain.latest_gradient()
         # heavy device call off the event loop: in-process clusters share one
         # loop, and a blocked loop starves every peer's timers
-        with self.phases.phase("sgd"):
+        with self.tele.span("sgd", it=it):
             if self.stepper is not None:
                 delta = await self.stepper.step(self.id, w, it)
             else:
@@ -1384,11 +1472,11 @@ class PeerAgent:
             # commitment = digest over the per-chunk Pedersen VSS coefficient
             # commitments: the exact object miners verify share rows against,
             # so verifier signatures and share verification bind together
-            with self.phases.phase("crypto_commit"):
+            with self.tele.span("crypto_commit", it=it):
                 vss = await asyncio.to_thread(self._vss_build, q, it)
             commitment = cm.vss_digest(vss[0])
         else:
-            with self.phases.phase("crypto_commit"):
+            with self.tele.span("crypto_commit", it=it):
                 commitment = await asyncio.to_thread(self._commit, q)
         u = Update(source_id=self.id, iteration=it, delta=delta,
                    commitment=commitment, noise=noise, noised_delta=noised)
@@ -1422,7 +1510,7 @@ class PeerAgent:
                     self._trace("verify_call_failed", verifier=v,
                                 error=f"{type(e).__name__}: {e}")
 
-            with self.phases.phase("verify_wait"):
+            with self.tele.span("verify_wait", it=it):
                 await asyncio.gather(*(ask(v) for v in verifiers))
             # approved iff ≥ half the verifiers signed (ref: main.go:1686)
             approved = len(sigs) >= max(1, (len(verifiers) + 1) // 2)
@@ -1448,7 +1536,7 @@ class PeerAgent:
         _, miners, _, _ = self.role_map.committee()
         if cfg.secure_agg and not cfg.fedsys:
             comms, blind_bytes, c_chunks = vss
-            with self.phases.phase("share_gen"):
+            with self.tele.span("share_gen", it=it):
                 blind_rows = await asyncio.to_thread(
                     self._vss_blind_rows, blind_bytes, c_chunks)
                 shares = np.asarray(ss.make_shares(
@@ -1641,7 +1729,7 @@ class PeerAgent:
                 # 3. reassemble rows and recover the aggregate
                 full = np.concatenate([slices[i] for i in range(len(miners))])
                 xs = np.asarray(ss.share_xs(cfg.total_shares))
-                with self.phases.phase("recovery"):
+                with self.tele.span("recovery", it=it):
                     agg = np.asarray(ss.recover_update(
                         full, xs, self.trainer.num_params, cfg.poly_size,
                         cfg.precision))
@@ -1823,7 +1911,7 @@ class PeerAgent:
         # same model on the same global test split, so all peers exit at the
         # same height and the chain-equality oracle holds (the reference
         # likewise scores the shared global data, ref: honest.go:141-162)
-        with self.phases.phase("metrics"):
+        with self.tele.span("metrics", it=it):
             if self.stepper is not None and hasattr(self.stepper,
                                                     "test_error"):
                 # co-located peers share one evaluation: identical model ×
@@ -1837,6 +1925,11 @@ class PeerAgent:
         self._trace("round_end", error=err)
         if err < cfg.convergence_error:
             self.converged = True
+        # round boundary = the recorder's durability point (its spill is
+        # batched, not per-event) and a natural moment to refresh the
+        # scrape gauges so a mid-run `Metrics` pull is never a round stale
+        self._refresh_gauges()
+        self.tele.flush()
 
     async def _announce(self) -> None:
         """Bootstrap: register with every peer concurrently, adopt the
@@ -1907,25 +2000,54 @@ class PeerAgent:
                 self._trace("checkpoint_rejected", step=step,
                             error="not adoptable")
         await self.server.start()
+        if self.cfg.metrics_port:
+            # optional HTTP exposition beside the RPC server: stock
+            # Prometheus (or curl) can scrape this peer with no protocol
+            # codec — same +node_id port layout as base_port
+            self._metrics_server = await serve_metrics(
+                self._render_metrics, self.cfg.my_ip,
+                self.cfg.metrics_port + self.id)
         if self.id != 0:
             await self._announce()
-        while not self.converged and self.iteration < self.cfg.max_iterations:
-            await self._run_round()
-            # two consecutive rounds advanced only by our own timeout-minted
-            # empty blocks: we are likely isolated (partition survivor or
-            # gossip-evicted) — re-announce to re-adopt the longest chain
-            # and re-enter peers' gossip sets (the reference can only heal
-            # via its startup announce; ref: localTest.sh's partition test
-            # was left commented out)
-            if getattr(self, "_empty_fallbacks", 0) >= 2:
-                self._trace("isolation_reannounce")
-                await self._announce()
-                self._empty_fallbacks = 0
-            if self.ckpt_dir and self.iteration % self.ckpt_every == 0:
-                from biscotti_tpu.utils import checkpoint as ckpt
+        try:
+            while not self.converged \
+                    and self.iteration < self.cfg.max_iterations:
+                await self._run_round()
+                # two consecutive rounds advanced only by our own
+                # timeout-minted empty blocks: we are likely isolated
+                # (partition survivor or gossip-evicted) — re-announce to
+                # re-adopt the longest chain and re-enter peers' gossip
+                # sets (the reference can only heal via its startup
+                # announce; ref: localTest.sh's partition test was left
+                # commented out)
+                if getattr(self, "_empty_fallbacks", 0) >= 2:
+                    self._trace("isolation_reannounce")
+                    await self._announce()
+                    self._empty_fallbacks = 0
+                if self.ckpt_dir and self.iteration % self.ckpt_every == 0:
+                    from biscotti_tpu.utils import checkpoint as ckpt
 
-                await asyncio.to_thread(ckpt.save, self.chain, self.ckpt_dir)
-                await asyncio.to_thread(ckpt.prune, self.ckpt_dir, 3)
+                    await asyncio.to_thread(ckpt.save, self.chain,
+                                            self.ckpt_dir)
+                    await asyncio.to_thread(ckpt.prune, self.ckpt_dir, 3)
+        except asyncio.CancelledError:
+            # routine teardown (a harness cancelling the task, Ctrl-C):
+            # drain the batched spill so the event log is complete, but a
+            # cancellation is not a crash — no forensic dump
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+            self.tele.close()
+            raise
+        except BaseException as e:
+            # crash path: the last `recorder_ring` events before the
+            # exception are exactly the forensic record the reference
+            # never had — dump the ring beside the spill file and flush
+            # whatever the batch buffer still holds, then re-raise
+            self.tele.crash_dump(reason=f"{type(e).__name__}: {e}")
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+            self.tele.close()
+            raise
         dump = self.chain.dump()
         # Linger before tearing down: the FINAL round's block gossip has no
         # later round to heal it — a peer that missed the push must pull
@@ -1941,8 +2063,10 @@ class PeerAgent:
         await asyncio.sleep(min(2.0, self.timeouts.rpc_s / 3))
         self.pool.close()
         await self.server.stop()
-        if self._events:
-            self._events.close()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+        snapshot = self.telemetry_snapshot()
+        self.tele.close()  # final flush of the batched spill
         return {
             "node": self.id,
             "iterations": self.iteration,
@@ -1960,7 +2084,17 @@ class PeerAgent:
             "health": self.health.snapshot(),
             "faults": (dict(self.pool.faults.counts)
                        if self.pool.faults is not None else {}),
+            # the unified readout (same schema the Metrics RPC serves):
+            # chaos harnesses, eval drivers, and tools/obs.py consume
+            # this; the flat keys above stay as the back-compat view
+            "telemetry": snapshot,
         }
+
+    def _render_metrics(self) -> str:
+        """Prometheus page for the optional HTTP endpoint — gauges are
+        refreshed per scrape (pull model, see _refresh_gauges)."""
+        self._refresh_gauges()
+        return self.tele.render()
 
 
 def main(argv=None) -> int:

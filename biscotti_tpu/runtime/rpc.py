@@ -456,6 +456,12 @@ class Pool:
         # is decided per (src, dst, msg_type, attempt) and applied at the
         # _Conn boundary so real TCP traffic is perturbed, not mocked.
         self.faults = None
+        # Optional telemetry registry (telemetry.MetricsRegistry): when
+        # set, every call/post ticks per-msg_type frame counters and
+        # reply-bearing calls feed a client-side latency histogram —
+        # round latency becomes attributable to transport vs. compute
+        # per link (the Garfield-style breakdown, PAPERS.md).
+        self.metrics = None
 
     def _evict(self, exempt: Optional[Tuple[str, int]] = None) -> None:
         # drop dead connections regardless of the cap, then close idle
@@ -518,10 +524,37 @@ class Pool:
                 await asyncio.sleep(d)
         fault = (self.faults.action(host, port, msg_type, attempt)
                  if self.faults is not None else None)
-        conn = await self._get(host, port, timeout)
-        remaining = max(0.001, deadline - loop.time())
-        rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays,
-                                              remaining, fault=fault)
+        m = self.metrics
+        t0 = loop.time()
+        try:
+            conn = await self._get(host, port, timeout)
+            if m is not None:
+                # counted only once a connection exists: a refused dial
+                # never put a frame on the wire and must not inflate the
+                # outbound-traffic attribution
+                m.counter("biscotti_rpc_frames_total",
+                          "outbound RPC frames by method and kind").inc(
+                    msg_type=msg_type, kind="call")
+            remaining = max(0.001, deadline - loop.time())
+            rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays,
+                                                  remaining, fault=fault)
+        except BaseException as e:
+            # cancellation is the CALLER giving up (shutdown, a superseding
+            # deadline), not the transport failing — keep it out of the
+            # failure counter the dashboards alert on
+            if m is not None and not isinstance(e, asyncio.CancelledError):
+                m.counter("biscotti_rpc_transport_failures_total",
+                          "calls that died in transport (timeout/refused/"
+                          "reset)").inc(msg_type=msg_type,
+                                        kind=type(e).__name__)
+            raise
+        if m is not None:
+            # any reply — including a protocol error — proves the
+            # transport round-trip; the histogram measures the wire+peer
+            # latency the retry/breaker plane acts on
+            m.histogram("biscotti_rpc_client_seconds",
+                        "reply-bearing RPC round-trip latency").observe(
+                loop.time() - t0, msg_type=msg_type)
         if rmeta.get("error"):
             if rmeta.get("stale"):
                 raise StaleError(rmeta["error"])
@@ -545,6 +578,10 @@ class Pool:
         fault = (self.faults.action(host, port, msg_type, attempt)
                  if self.faults is not None else None)
         conn = await self._get(host, port, timeout)
+        if self.metrics is not None:
+            self.metrics.counter("biscotti_rpc_frames_total",
+                                 "outbound RPC frames by method and kind"
+                                 ).inc(msg_type=msg_type, kind="post")
         await conn._send(frame, max(0.001, deadline - loop.time()),
                          fault=fault)
 

@@ -1,0 +1,38 @@
+"""biscotti_tpu.telemetry — the unified telemetry plane.
+
+Four pieces (docs/OBSERVABILITY.md):
+
+  * `MetricsRegistry` — counters / gauges / histograms with labels,
+    fixed log-scale latency buckets, bounded label cardinality, and
+    Prometheus text rendering (registry.py).
+  * `Telemetry.span` — round-correlated timing contexts feeding the
+    phase histogram, the legacy PhaseClock totals, and the recorder
+    (core.py).
+  * `FlightRecorder` — bounded event ring with batched JSONL spill and
+    crash dump; every event stamped (wall, monotonic, seq) (recorder.py).
+  * `serve_metrics` — optional local HTTP exposition; the peer's
+    `Metrics` RPC is the primary scrape path (runtime/peer.py,
+    tools/obs.py).
+
+The whole package is stdlib-only: importing it (or running with
+telemetry disabled, which swaps in the NULL_* no-op singletons) pulls in
+neither jax nor numpy — asserted by tests/test_telemetry.py's smoke test.
+"""
+
+from biscotti_tpu.telemetry.core import (  # noqa: F401
+    NULL_RECORDER,
+    NULL_REGISTRY,
+    NullRecorder,
+    NullRegistry,
+    Telemetry,
+    serve_metrics,
+)
+from biscotti_tpu.telemetry.recorder import FlightRecorder  # noqa: F401
+from biscotti_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    quantile_from_buckets,
+)

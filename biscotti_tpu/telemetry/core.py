@@ -1,0 +1,217 @@
+"""Telemetry facade: round-correlated spans + events over one registry
+and one flight recorder, with an optional local HTTP exposition endpoint.
+
+One `Telemetry` object per peer (or per tool run) ties the three pieces
+together:
+
+  * `span(name, it=...)` — times a phase and charges it three ways at
+    once: the PhaseClock totals (the `run()` result's legacy `phases`
+    key), a `biscotti_phase_seconds{phase=...}` histogram (per-phase
+    p50/p99 for the cluster scraper), and a structured `span` event in
+    the flight recorder carrying the blockchain iteration — so every
+    timing is attributable to a round (the Garfield/NET-SA requirement:
+    crypto vs transport vs compute per node per round).
+  * `event(name, it=..., **kw)` — structured protocol event: counted in
+    `biscotti_events_total{event=...}` and recorded in the ring.
+  * `snapshot()/render()` — the structured / Prometheus-text readouts.
+
+Disabled mode (`Telemetry(enabled=False)`, cfg.telemetry=0): the registry
+and recorder are module-level null singletons whose methods do nothing
+and allocate nothing, and `span` still feeds the PhaseClock — exactly the
+pre-telemetry accounting cost, nothing more (asserted by the smoke test).
+One carve-out: an explicitly configured spill path keeps a REAL recorder
+even when disabled, because the event log predates this subsystem and
+`--telemetry 0 --log-dir ...` must keep producing it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from biscotti_tpu.telemetry.recorder import FlightRecorder
+from biscotti_tpu.telemetry.registry import MetricsRegistry
+from biscotti_tpu.utils.profiling import PhaseClock
+
+
+class _NullMetric:
+    """Accepts any counter/gauge/histogram call and does nothing."""
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        pass
+
+    def set(self, value: float, **labels) -> None:
+        pass
+
+    def observe(self, value: float, **labels) -> None:
+        pass
+
+    def value(self, **labels) -> float:
+        return 0.0
+
+
+class NullRegistry:
+    """Shape-compatible no-op registry (one shared metric object, zero
+    per-call allocation)."""
+
+    _METRIC = _NullMetric()
+
+    def counter(self, name: str, help: str = "") -> _NullMetric:
+        return self._METRIC
+
+    def gauge(self, name: str, help: str = "") -> _NullMetric:
+        return self._METRIC
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=None) -> _NullMetric:
+        return self._METRIC
+
+    def snapshot(self) -> Dict:
+        return {}
+
+    def render(self) -> str:
+        return ""
+
+
+class NullRecorder:
+    """Shape-compatible no-op flight recorder."""
+
+    pending = 0
+    wrapped = 0
+
+    def record(self, event: str, **fields) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def tail(self, n: int = 50):
+        return []
+
+    def crash_dump(self, path: str, reason: str = "") -> None:
+        return None
+
+
+NULL_REGISTRY = NullRegistry()
+NULL_RECORDER = NullRecorder()
+
+
+class Telemetry:
+    def __init__(self, node: int = 0, enabled: bool = True,
+                 ring: int = 4096, spill_path: str = "",
+                 spill_batch: int = 256,
+                 registry: Optional[MetricsRegistry] = None,
+                 max_label_sets: int = 256):
+        self.node = node
+        self.enabled = bool(enabled)
+        # PhaseClock runs in BOTH modes: its totals are the run() result's
+        # back-compat `phases` key and predate this subsystem (its cost is
+        # the pre-PR baseline, not telemetry overhead)
+        self.phases = PhaseClock()
+        if self.enabled:
+            self.registry: MetricsRegistry = registry or MetricsRegistry(
+                max_label_sets=max_label_sets)
+            self._span_hist = self.registry.histogram(
+                "biscotti_phase_seconds",
+                "per-phase wall-clock, attributable to one iteration")
+            self._event_ctr = self.registry.counter(
+                "biscotti_events_total", "structured protocol events")
+        else:
+            self.registry = NULL_REGISTRY  # type: ignore[assignment]
+            self._span_hist = NullRegistry._METRIC
+            self._event_ctr = NullRegistry._METRIC
+        # an explicitly-requested event log (spill_path) is honoured even
+        # with the metrics plane disabled: pre-telemetry, `log_path`
+        # always produced per-event JSONL, and --telemetry 0 must not
+        # silently discard it. Fully off = disabled AND no spill path.
+        if self.enabled or spill_path:
+            self.recorder = FlightRecorder(node=node, capacity=ring,
+                                           spill_path=spill_path,
+                                           batch=spill_batch)
+        else:
+            self.recorder = NULL_RECORDER  # type: ignore[assignment]
+        self._crash_path = spill_path + ".crash" if spill_path else ""
+
+    # -------------------------------------------------------------- spans
+
+    @contextlib.contextmanager
+    def span(self, name: str, it: Optional[int] = None):
+        """Round-correlated timing context (see module docstring)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.phases.add(name, dt)
+            self._span_hist.observe(dt, phase=name)
+            self.recorder.record("span", iter=it, phase=name,
+                                 dur_s=round(dt, 6))
+
+    def event(self, name: str, it: Optional[int] = None, **kw) -> None:
+        # both sinks are null singletons when their half is off: metrics
+        # need enabled=True, the recorder additionally honours a
+        # configured spill path (see __init__)
+        self._event_ctr.inc(event=name)
+        self.recorder.record(name, iter=it, **kw)
+
+    # ------------------------------------------------------------ readout
+
+    def phase_summary(self) -> Dict[str, Dict[str, float]]:
+        return self.phases.summary()
+
+    def render(self) -> str:
+        return self.registry.render()
+
+    def flush(self) -> None:
+        self.recorder.flush()
+
+    def crash_dump(self, reason: str = "") -> Optional[str]:
+        """Dump the event ring next to the spill file (no-op when no
+        spill path is configured — there is nowhere agreed to write)."""
+        return self.recorder.crash_dump(self._crash_path, reason=reason)
+
+    def close(self) -> None:
+        self.recorder.close()
+
+
+# ----------------------------------------------------------- exposition
+
+
+async def serve_metrics(render_fn, host: str, port: int):
+    """Minimal asyncio HTTP/1.0 endpoint serving `render_fn()` as a
+    Prometheus text page on every GET (path ignored: /metrics and / are
+    the same page). Returns the asyncio server; caller closes it.
+
+    stdlib-only by design — the point is `curl host:port/metrics` and
+    stock Prometheus scraping against a live peer with zero extra deps.
+    """
+    import asyncio
+
+    async def handle(reader, writer):
+        try:
+            # consume request line + headers (bounded: hostile clients
+            # must not pin the handler)
+            for _ in range(64):
+                line = await asyncio.wait_for(reader.readline(), 5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            body = render_fn().encode()
+            writer.write(
+                b"HTTP/1.0 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n"
+                + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    return await asyncio.start_server(handle, host, port)

@@ -40,12 +40,26 @@ def chain_oracle(results) -> Tuple[bool, int, int]:
 
 
 def tally_faults(results) -> Dict[str, int]:
-    """Sum the per-peer injected-fault counters across a cluster run."""
+    """Sum the per-peer injected-fault tallies across a cluster run —
+    read from each result's TELEMETRY snapshot (the one public readout
+    the Metrics RPC also serves); the legacy flat `faults` key is the
+    fallback for pre-telemetry result dicts."""
     fired: Dict[str, int] = {}
     for r in results:
-        for k, v in r["faults"].items():
+        faults = r.get("telemetry", {}).get("faults") or r.get("faults", {})
+        for k, v in faults.items():
             fired[k] = fired.get(k, 0) + v
     return fired
+
+
+def cluster_table(results) -> Dict:
+    """Merged cluster view over the per-peer telemetry snapshots — one
+    definition shared with `python -m biscotti_tpu.tools.obs` (which
+    scrapes the same snapshots live over the Metrics RPC)."""
+    from biscotti_tpu.tools import obs
+
+    return obs.merge_snapshots([r["telemetry"] for r in results
+                                if "telemetry" in r])
 
 
 def main(argv=None) -> int:
@@ -101,6 +115,11 @@ def main(argv=None) -> int:
     results = asyncio.run(go())
     prefix_equal, common, real_blocks = chain_oracle(results)
     faults_fired = tally_faults(results)
+    # every robustness readout below comes off the telemetry snapshots —
+    # the same schema the Metrics RPC serves a live scrape, so a chaos
+    # report and `tools.obs` against a running cluster agree by
+    # construction
+    cluster = cluster_table(results)
     report = {
         "nodes": ns.nodes, "rounds": ns.rounds,
         "fault_plan": {"seed": plan.seed, "drop": plan.drop,
@@ -110,13 +129,12 @@ def main(argv=None) -> int:
         "settled_height": common,
         "real_blocks": real_blocks,
         "faults_injected": faults_fired,
-        "rpc_retries": sum(r["counters"].get("rpc_retry", 0)
-                           for r in results),
-        "breaker_opens": sum(r["counters"].get("breaker_open", 0)
-                             for r in results),
-        "per_node": [{"node": r["node"], "iterations": r["iterations"],
-                      "faults": r["faults"], "health": r["health"]}
-                     for r in results],
+        "rpc_retries": cluster["counters"].get("rpc_retry", 0),
+        "breaker_opens": cluster["counters"].get("breaker_open", 0),
+        "cluster": cluster,
+        "per_node": [{"node": s["node"], "iterations": s["iter"],
+                      "faults": s["faults"], "health": s["health"]}
+                     for s in (r["telemetry"] for r in results)],
     }
     print(json.dumps(report, indent=2))
     return 0 if prefix_equal and real_blocks >= 1 else 1

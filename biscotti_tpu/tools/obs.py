@@ -1,0 +1,237 @@
+"""Live-cluster observability CLI: scrape every peer's `Metrics` RPC and
+merge the per-peer snapshots into one cluster table.
+
+    python -m biscotti_tpu.tools.obs --nodes 4 --base-port 8000
+    python -m biscotti_tpu.tools.obs --nodes 4 --tail 20      # + recent events
+    python -m biscotti_tpu.tools.obs --nodes 4 --json         # machine-readable
+    python -m biscotti_tpu.tools.obs --nodes 4 --watch 2      # rescrape loop
+
+What the reference could only reconstruct after the fact by parsing
+timestamped text logs (SURVEY §5.1) is here one command against a RUNNING
+cluster: per-peer round height + cluster skew, circuit-breaker states,
+injected-fault tallies, and per-phase latency quantiles (p50/p99 from the
+fixed log-scale histograms, merged bucket-wise across peers — valid
+because every peer shares registry.DEFAULT_BUCKETS).
+
+`merge_snapshots` is also the ONE definition of the cluster-level readout:
+the chaos CLI report and the test suites consume it rather than each
+reinventing their own aggregation over private peer state
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Dict, List, Optional
+
+from biscotti_tpu.telemetry.registry import quantile_from_buckets
+
+OPEN_STATES = ("open", "half_open")
+
+
+def merge_phase_histograms(snaps: List[Dict]) -> Dict[str, Dict]:
+    """Merge every peer's `biscotti_phase_seconds` histogram bucket-wise
+    and return {phase: {p50, p99, count, total_s}}. Peers with telemetry
+    disabled contribute their PhaseClock summary instead (mean only —
+    quantiles need the buckets)."""
+    merged: Dict[str, Dict] = {}
+    for snap in snaps:
+        fam = (snap.get("metrics") or {}).get("biscotti_phase_seconds")
+        if fam and fam.get("series"):
+            bounds = fam["bounds"]
+            for row in fam["series"]:
+                phase = row["labels"].get("phase", "?")
+                m = merged.setdefault(phase, {
+                    "bounds": bounds,
+                    "buckets": [0] * (len(bounds) + 1),
+                    "count": 0, "total_s": 0.0})
+                if m["buckets"] is None:
+                    # a telemetry-off peer created this entry first:
+                    # upgrade it so this peer's buckets still merge
+                    m["bounds"] = bounds
+                    m["buckets"] = [0] * (len(bounds) + 1)
+                m["buckets"] = [a + b for a, b in zip(m["buckets"],
+                                                      row["buckets"])]
+                m["count"] += row["count"]
+                m["total_s"] += row["sum"]
+        else:  # telemetry-off peer: PhaseClock totals only — counts and
+            # totals still aggregate; the buckets (if any peer has them)
+            # are left untouched, so quantiles cover the enabled subset
+            for phase, row in (snap.get("phases") or {}).items():
+                m = merged.setdefault(phase, {"bounds": None, "buckets": None,
+                                              "count": 0, "total_s": 0.0})
+                m["count"] += row["calls"]
+                m["total_s"] += row["total_s"]
+    out: Dict[str, Dict] = {}
+    for phase, m in sorted(merged.items(), key=lambda kv: -kv[1]["total_s"]):
+        row = {"count": m["count"], "total_s": round(m["total_s"], 4)}
+        if m["buckets"] is not None:
+            row["p50_s"] = quantile_from_buckets(m["bounds"], m["buckets"], .5)
+            row["p99_s"] = quantile_from_buckets(m["bounds"], m["buckets"],
+                                                 .99)
+        out[phase] = row
+    return out
+
+
+def merge_snapshots(snaps: List[Dict]) -> Dict:
+    """One cluster table from per-peer telemetry snapshots (the schema
+    `PeerAgent.telemetry_snapshot()` / the `Metrics` RPC serve)."""
+    heights = {s.get("node", i): int(s.get("iter", 0))
+               for i, s in enumerate(snaps)}
+    faults: Dict[str, int] = {}
+    counters: Dict[str, int] = {}
+    per_node = []
+    breakers_open = 0
+    for s in snaps:
+        for k, v in (s.get("faults") or {}).items():
+            faults[k] = faults.get(k, 0) + int(v)
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        health = s.get("health") or {}
+        quarantined = sorted(p for p, h in health.items()
+                             if h.get("state") in OPEN_STATES)
+        breakers_open += len(quarantined)
+        per_node.append({
+            "node": s.get("node"),
+            "iter": s.get("iter", 0),
+            "converged": bool(s.get("converged", False)),
+            "quarantined": quarantined,
+            "breaker_opens": sum(h.get("opens", 0) for h in health.values()),
+            "fast_fails": sum(h.get("fast_fails", 0)
+                              for h in health.values()),
+            "faults": dict(s.get("faults") or {}),
+        })
+    hs = list(heights.values()) or [0]
+    return {
+        "nodes": len(snaps),
+        "round_height": {"min": min(hs), "max": max(hs),
+                         "skew": max(hs) - min(hs)},
+        "breakers_open": breakers_open,
+        "faults": faults,
+        "counters": counters,
+        "phases": merge_phase_histograms(snaps),
+        "per_node": per_node,
+    }
+
+
+def format_table(merged: Dict) -> str:
+    """Human-readable cluster table."""
+    rh = merged["round_height"]
+    lines = [
+        f"cluster: {merged['nodes']} peers   "
+        f"round height {rh['min']}..{rh['max']} (skew {rh['skew']})   "
+        f"breakers open: {merged['breakers_open']}",
+        "",
+        f"{'node':>5} {'iter':>5} {'conv':>5} {'opens':>6} "
+        f"{'fastfail':>8}  quarantined / faults",
+    ]
+    for n in merged["per_node"]:
+        extra = []
+        if n["quarantined"]:
+            extra.append("quarantine=" + ",".join(map(str, n["quarantined"])))
+        if n["faults"]:
+            extra.append("faults=" + ",".join(
+                f"{k}:{v}" for k, v in sorted(n["faults"].items())))
+        lines.append(f"{n['node']!s:>5} {n['iter']:>5} "
+                     f"{str(n['converged'])[:1]:>5} {n['breaker_opens']:>6} "
+                     f"{n['fast_fails']:>8}  {' '.join(extra)}")
+    if merged["faults"]:
+        lines += ["", "injected faults (cluster): " + ", ".join(
+            f"{k}={v}" for k, v in sorted(merged["faults"].items()))]
+    if merged["phases"]:
+        lines += ["", f"{'phase':<16} {'calls':>7} {'total_s':>9} "
+                      f"{'p50_s':>9} {'p99_s':>9}"]
+        for phase, row in merged["phases"].items():
+            p50 = row.get("p50_s")
+            p99 = row.get("p99_s")
+            lines.append(
+                f"{phase:<16} {row['count']:>7} {row['total_s']:>9.3f} "
+                f"{p50 if p50 is not None else '-':>9} "
+                f"{p99 if p99 is not None else '-':>9}")
+    return "\n".join(lines)
+
+
+async def scrape(host: str, ports: List[int], tail: int = 0,
+                 timeout: float = 5.0) -> List[Dict]:
+    """Pull every peer's Metrics RPC concurrently; unreachable peers are
+    reported as {'unreachable': True} rows rather than sinking the
+    scrape (a dead peer is exactly when you want the rest of the
+    table)."""
+    from biscotti_tpu.runtime import rpc
+
+    async def one(port: int) -> Dict:
+        try:
+            rmeta, _ = await rpc.call(host, port, "Metrics",
+                                      {"tail": tail} if tail else {},
+                                      timeout=timeout)
+            snap = rmeta["snapshot"]
+            if tail:
+                snap["events"] = rmeta.get("events", [])
+            return snap
+        except Exception as e:
+            return {"node": None, "port": port, "unreachable": True,
+                    "error": f"{type(e).__name__}: {e}"}
+
+    return list(await asyncio.gather(*(one(p) for p in ports)))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scrape a live biscotti cluster's telemetry")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--base-port", type=int, default=8000)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--ports", default="",
+                    help="explicit comma-separated ports (overrides "
+                         "--base-port/--nodes arithmetic)")
+    ap.add_argument("--tail", type=int, default=0,
+                    help="also print the newest N flight-recorder events "
+                         "per peer, merged and time-sorted")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged snapshot as JSON")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="rescrape every N seconds until interrupted")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ns = ap.parse_args(argv)
+    ports = ([int(p) for p in ns.ports.split(",") if p] if ns.ports
+             else [ns.base_port + i for i in range(ns.nodes)])
+
+    def once() -> int:
+        snaps = asyncio.run(scrape(ns.host, ports, tail=ns.tail,
+                                   timeout=ns.timeout))
+        up = [s for s in snaps if not s.get("unreachable")]
+        down = [s for s in snaps if s.get("unreachable")]
+        merged = merge_snapshots(up)
+        merged["unreachable"] = [s["port"] for s in down]
+        if ns.json:
+            print(json.dumps(merged, indent=2, default=str))
+        else:
+            print(format_table(merged))
+            if down:
+                print(f"\nunreachable: ports "
+                      f"{', '.join(str(s['port']) for s in down)}")
+            if ns.tail:
+                events = [e for s in up for e in s.get("events", [])]
+                events.sort(key=lambda e: e.get("ts", 0.0))
+                print(f"\nlast events ({len(events)}):")
+                for e in events[-ns.tail:]:
+                    print(json.dumps(e, default=str))
+        return 0 if up else 1
+
+    if ns.watch > 0:
+        try:
+            while True:
+                print(f"--- scrape @ {time.strftime('%H:%M:%S')} ---")
+                once()
+                time.sleep(ns.watch)
+        except KeyboardInterrupt:
+            return 0
+    return once()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

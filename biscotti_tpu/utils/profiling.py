@@ -50,15 +50,19 @@ class PhaseClock:
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
 
+    def add(self, name: str, dt: float) -> None:
+        """Charge `dt` seconds to `name` — the ONE accounting invariant,
+        shared by phase() and telemetry spans (telemetry/core.py)."""
+        self.totals[name] = self.totals.get(name, 0.0) + dt
+        self.counts[name] = self.counts.get(name, 0) + 1
+
     @contextlib.contextmanager
     def phase(self, name: str):
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.add(name, time.perf_counter() - t0)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         return {

@@ -69,16 +69,21 @@ def main(argv=None) -> int:
     with ctx:
         agents, results = asyncio.run(go())
 
-    # aggregate per-phase totals across peers; normalize per round
-    phases = {}
-    for a in agents:
-        for name, row in a.phases.summary().items():
-            agg = phases.setdefault(name, {"total_s": 0.0, "calls": 0})
-            agg["total_s"] += row["total_s"]
-            agg["calls"] += row["calls"]
-    for name, agg in phases.items():
-        agg["total_s"] = round(agg["total_s"], 3)
-        agg["s_per_call"] = round(agg["total_s"] / max(1, agg["calls"]), 5)
+    # aggregate per-phase costs across peers off the TELEMETRY snapshots
+    # each run() result carries (the same schema the Metrics RPC serves a
+    # live scrape). obs.merge_phase_histograms is the ONE aggregation:
+    # it returns per-phase count/total_s (all peers) and p50/p99 from the
+    # merged log-scale histograms; the legacy totals table is a view of it
+    from biscotti_tpu.tools import obs
+
+    snaps = [r["telemetry"] for r in results]
+    quantiles = obs.merge_phase_histograms(snaps)
+    phases = {
+        name: {"total_s": round(row["total_s"], 3),
+               "calls": row["count"],
+               "s_per_call": round(row["total_s"] / max(1, row["count"]), 5)}
+        for name, row in quantiles.items()
+    }
 
     dumps = [r["chain_dump"] for r in results]
     summary = {
@@ -87,8 +92,10 @@ def main(argv=None) -> int:
         "iterations": args.iterations,
         "secure_agg": bool(args.secure_agg),
         "chains_equal": all(d == dumps[0] for d in dumps),
-        "phases": dict(sorted(phases.items(),
-                              key=lambda kv: -kv[1]["total_s"])),
+        "phases": phases,  # already ordered by -total_s (obs merge)
+        # per-phase latency quantiles from the merged telemetry histograms
+        # (p50/p99 — the distribution the total_s means hide)
+        "phase_quantiles": quantiles,
         "device_trace": args.trace_dir or None,
     }
     print(json.dumps(summary))

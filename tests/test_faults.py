@@ -289,7 +289,9 @@ def test_call_retries_transport_failures_then_succeeds():
     rmeta, _ = asyncio.run(agent._call(1, "Echo"))
     assert rmeta["ok"] == 1
     assert attempts == [0, 1, 2], "each retry must carry a fresh attempt no."
-    assert agent.counters.get("rpc_retry", 0) == 2
+    # readout via the public telemetry snapshot (the Metrics RPC schema),
+    # not the private counters dict
+    assert agent.telemetry_snapshot()["counters"].get("rpc_retry", 0) == 2
     assert agent.health.state(1) == faults.CLOSED, \
         "final success must reset the streak"
     assert 1 in agent.alive
@@ -323,7 +325,7 @@ def test_call_fails_fast_when_breaker_open():
     with pytest.raises(ConnectionError):
         asyncio.run(agent._call(1, "Echo"))  # 3 attempts = threshold: opens
     assert agent.health.state(1) == faults.OPEN
-    assert agent.counters.get("breaker_open", 0) == 1
+    assert agent.telemetry_snapshot()["counters"].get("breaker_open", 0) == 1
 
     async def must_not_dial(*a, **k):
         raise AssertionError("quarantined peer was dialed")
@@ -331,7 +333,11 @@ def test_call_fails_fast_when_breaker_open():
     agent.pool.call = must_not_dial
     with pytest.raises(CircuitOpenError):
         asyncio.run(agent._call(1, "Echo"))
-    assert agent.counters.get("rpc_fast_fail", 0) == 1
+    snap = agent.telemetry_snapshot()
+    assert snap["counters"].get("rpc_fast_fail", 0) == 1
+    # the breaker state is scrapeable as a gauge too (0/1/2 levels)
+    assert snap["metrics"]["biscotti_breaker_state"]["series"], \
+        "breaker gauge missing from the metrics snapshot"
 
 
 def test_fault_plan_rides_the_cli():
@@ -427,11 +433,13 @@ def test_breaker_quarantines_killed_peer_and_readmits_on_rejoin():
         await _wait_height(agents[0], 3)
         await _hard_stop(agents[victim], tasks[victim])
         # several rounds without the victim: breakers must trip and the
-        # survivors must stop burning round budget on it
+        # survivors must stop burning round budget on it. All mid-run
+        # evidence comes off telemetry_snapshot() — the same public
+        # readout the Metrics RPC serves — NOT private peer dicts.
         await _wait_height(agents[0], 8)
-        mid_health = [a.health.snapshot().get(victim, {}) for a in agents
-                      if a.id != victim]
-        mid_counters = [dict(a.counters) for a in agents if a.id != victim]
+        mid = [a.telemetry_snapshot() for a in agents if a.id != victim]
+        mid_health = [s["health"].get(str(victim), {}) for s in mid]
+        mid_counters = [s["counters"] for s in mid]
         reborn = PeerAgent(_cfg(victim, n, port, max_iterations=iters,
                                 breaker_threshold=3,
                                 breaker_cooldown_s=2.0))
@@ -451,12 +459,14 @@ def test_breaker_quarantines_killed_peer_and_readmits_on_rejoin():
     assert any(c.get("breaker_open", 0) >= 1 for c in mid_counters)
     # 2. after the rejoin, the breaker closed again (inbound announce or a
     #    successful half-open probe) and gossip resumed — the reborn peer
-    #    holds the network's settled chain (checked by the oracle above)
-    end_counters = [dict(a.counters) for a in survivors]
-    assert any(c.get("breaker_close", 0) >= 1 for c in end_counters), \
-        f"breaker never closed after rejoin: {end_counters}"
-    for a in survivors:
-        assert a.health.snapshot().get(victim, {}).get("state") \
+    #    holds the network's settled chain (checked by the oracle above).
+    #    End-state evidence comes from the run() results' telemetry
+    #    snapshots, the same schema a live Metrics scrape returns.
+    end = [r["telemetry"] for r in results[:-1]]  # survivors; reborn is last
+    assert any(s["counters"].get("breaker_close", 0) >= 1 for s in end), \
+        f"breaker never closed after rejoin: {[s['counters'] for s in end]}"
+    for s in end:
+        assert s["health"].get(str(victim), {}).get("state") \
             != faults.OPEN, "victim still quarantined after rejoining"
 
 

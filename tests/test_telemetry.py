@@ -1,0 +1,445 @@
+"""Telemetry plane tests (biscotti_tpu/telemetry, docs/OBSERVABILITY.md).
+
+Unit level: registry semantics (counter/gauge/histogram, label cardinality
+cap, bucket placement, type-conflict detection), Prometheus text rendering,
+bucket-quantile estimation, flight-recorder ring wraparound + batched spill
++ crash dump, and the disabled-mode smoke test (instrumentation must be
+no-ops and the package import must stay stdlib-only).
+
+Integration level: a live 4-node DEALER-KEYED cluster is scraped mid-run
+through the `Metrics` RPC (the acceptance point): per-peer Prometheus
+snapshots come back while training is in flight, round-height gauges
+advance between scrapes, and `tools.obs` merges the per-peer snapshots
+into one cluster table. A tier-1 guard asserts `PeerAgent.run()` still
+returns the legacy `health`/`faults`/`phases` keys next to the new
+`telemetry` snapshot.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.telemetry import (
+    DEFAULT_BUCKETS,
+    NULL_RECORDER,
+    NULL_REGISTRY,
+    FlightRecorder,
+    MetricsRegistry,
+    Telemetry,
+    quantile_from_buckets,
+    serve_metrics,
+)
+
+FAST = Timeouts(update_s=4.0, block_s=20.0, krum_s=4.0, share_s=4.0,
+                rpc_s=6.0)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_gauge_semantics_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("biscotti_events_total", "events")
+    c.inc()
+    c.inc(2.0)
+    c.inc(event="round_end")
+    assert c.value() == 3.0
+    assert c.value(event="round_end") == 1.0
+    assert c.value(event="never_seen") == 0.0
+    g = reg.gauge("biscotti_round_height", "height")
+    g.set(4)
+    g.set(7)
+    assert g.value() == 7.0
+    g.inc(peer=3)
+    g.inc(peer=3)
+    assert g.value(peer=3) == 2.0
+    # get-or-create is idempotent per name...
+    assert reg.counter("biscotti_events_total") is c
+    # ...and re-declaring a name as another kind is a programming error
+    with pytest.raises(TypeError):
+        reg.gauge("biscotti_events_total")
+
+
+def test_histogram_bucket_placement():
+    reg = MetricsRegistry()
+    h = reg.histogram("biscotti_phase_seconds", "t", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.005, phase="sgd")    # -> le=0.01
+    h.observe(0.05, phase="sgd")     # -> le=0.1
+    h.observe(0.01, phase="sgd")     # boundary lands in its own le bucket
+    h.observe(50.0, phase="sgd")     # -> +Inf
+    snap = reg.snapshot()["biscotti_phase_seconds"]
+    assert snap["bounds"] == [0.01, 0.1, 1.0]
+    (row,) = snap["series"]
+    assert row["labels"] == {"phase": "sgd"}
+    assert row["buckets"] == [2, 1, 0, 1]
+    assert row["count"] == 4
+    assert row["sum"] == pytest.approx(50.065)
+    # misordered bucket tables are rejected at declaration time
+    with pytest.raises(ValueError):
+        reg.histogram("biscotti_bad_seconds", buckets=(1.0, 0.5))
+
+
+def test_label_cardinality_cap_collapses_to_overflow():
+    reg = MetricsRegistry(max_label_sets=4)
+    c = reg.counter("biscotti_rpc_frames_total")
+    for i in range(10):
+        c.inc(msg_type=f"m{i}")
+    assert c.series_count() <= 5  # 4 real series + the shared overflow one
+    assert c.value(overflow="true") == 6.0  # every capped inc lands there
+    assert reg.overflow_series == 6
+    # existing series keep working at the cap
+    c.inc(msg_type="m0")
+    assert c.value(msg_type="m0") == 2.0
+
+
+def test_prometheus_text_rendering():
+    reg = MetricsRegistry()
+    reg.counter("biscotti_events_total", "protocol events").inc(
+        3, event='we"ird\nname')
+    h = reg.histogram("biscotti_phase_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05, phase="sgd")
+    h.observe(5.0, phase="sgd")
+    page = reg.render()
+    assert "# HELP biscotti_events_total protocol events" in page
+    assert "# TYPE biscotti_events_total counter" in page
+    assert 'biscotti_events_total{event="we\\"ird\\nname"} 3.0' in page
+    # histogram: cumulative buckets, +Inf, _sum/_count
+    assert 'biscotti_phase_seconds_bucket{phase="sgd",le="0.1"} 1' in page
+    assert 'biscotti_phase_seconds_bucket{phase="sgd",le="1.0"} 1' in page
+    assert 'biscotti_phase_seconds_bucket{phase="sgd",le="+Inf"} 2' in page
+    assert 'biscotti_phase_seconds_count{phase="sgd"} 2' in page
+    assert page.endswith("\n")
+
+
+def test_quantile_from_buckets():
+    bounds = (0.1, 1.0, 10.0)
+    # 10 obs <=0.1, 85 in (0.1,1], 4 in (1,10], 1 beyond
+    counts = [10, 85, 4, 1]
+    assert quantile_from_buckets(bounds, counts, 0.5) == 1.0
+    assert quantile_from_buckets(bounds, counts, 0.05) == 0.1
+    assert quantile_from_buckets(bounds, counts, 0.99) == 10.0
+    # observations beyond the last finite bound report that bound
+    assert quantile_from_buckets(bounds, counts, 1.0) == 10.0
+    assert quantile_from_buckets(bounds, [0, 0, 0, 0], 0.5) == 0.0
+
+
+# ------------------------------------------------------- flight recorder
+
+
+def test_ring_wraparound_and_ordering():
+    rec = FlightRecorder(node=1, capacity=8)
+    for i in range(20):
+        rec.record("tick", i=i)
+    assert rec.wrapped == 12
+    tail = rec.tail(100)
+    assert len(tail) == 8  # bounded by capacity
+    assert [e["i"] for e in tail] == list(range(12, 20))
+    # seq strictly increases; every event carries the (wall, mono) pair
+    seqs = [e["seq"] for e in tail]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    monos = [e["mono"] for e in tail]
+    assert monos == sorted(monos)
+    assert all("ts" in e and "mono" in e and e["node"] == 1 for e in tail)
+    assert rec.tail(3) == tail[-3:]
+    assert rec.tail(0) == []
+
+
+def test_batched_spill_and_flush(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = FlightRecorder(capacity=64, spill_path=path, batch=4)
+    for i in range(3):
+        rec.record("tick", i=i)
+    assert rec.pending == 3
+    assert os.path.getsize(path) == 0, \
+        "spill must be batched — 3 events < batch must not hit the file"
+    rec.record("tick", i=3)  # 4th event = batch boundary -> one write
+    assert rec.pending == 0
+    rec.record("tick", i=4)
+    rec.flush()  # explicit flush drains the partial batch
+    rec.close()
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert [e["i"] for e in lines] == [0, 1, 2, 3, 4]
+    # unserializable field values must never raise (default=str)
+    rec2 = FlightRecorder(capacity=4, spill_path=str(tmp_path / "o.jsonl"),
+                          batch=1)
+    rec2.record("odd", obj=object())
+    rec2.close()
+
+
+def test_crash_dump_writes_ring_and_trailer(tmp_path):
+    rec = FlightRecorder(node=2, capacity=4)
+    for i in range(6):
+        rec.record("tick", i=i)
+    path = str(tmp_path / "crash.jsonl")
+    assert rec.crash_dump(path, reason="RuntimeError: boom") == path
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert [e["i"] for e in lines[:-1]] == [2, 3, 4, 5]  # the ring, in order
+    trailer = lines[-1]
+    assert trailer["event"] == "crash_dump"
+    assert trailer["reason"] == "RuntimeError: boom"
+    assert trailer["ring_events"] == 4 and trailer["wrapped"] == 2
+    assert rec.crash_dump("", reason="no path") is None
+
+
+# ------------------------------------------------------------ Telemetry
+
+
+def test_span_feeds_phaseclock_histogram_and_recorder():
+    tele = Telemetry(node=3)
+    with tele.span("sgd", it=7):
+        pass
+    with tele.span("sgd", it=8):
+        pass
+    assert tele.phases.counts["sgd"] == 2
+    assert tele.phases.totals["sgd"] >= 0.0
+    snap = tele.registry.snapshot()["biscotti_phase_seconds"]
+    (row,) = snap["series"]
+    assert row["labels"] == {"phase": "sgd"} and row["count"] == 2
+    events = tele.recorder.tail(10)
+    assert [(e["event"], e["iter"], e["phase"]) for e in events] == \
+        [("span", 7, "sgd"), ("span", 8, "sgd")]
+    tele.event("round_end", it=8, error=0.5)
+    assert tele.recorder.tail(1)[0]["error"] == 0.5
+    assert tele.registry.counter("biscotti_events_total").value(
+        event="round_end") == 1.0
+
+
+def test_disabled_telemetry_is_noop_smoke():
+    """The acceptance smoke test: with cfg.telemetry off the whole plane
+    is the shared null singletons — zero state accumulates, rendering is
+    empty, and spans still feed the legacy PhaseClock (the pre-telemetry
+    accounting, not overhead added by this PR)."""
+    tele = Telemetry(enabled=False, spill_path="")
+    assert tele.registry is NULL_REGISTRY
+    assert tele.recorder is NULL_RECORDER
+    # every accessor hands back ONE shared metric object: no per-call
+    # allocation on the disabled hot path
+    m = tele.registry.counter("biscotti_x_total")
+    assert m is tele.registry.histogram("biscotti_y_seconds")
+    m.inc(), m.set(3.0), m.observe(0.1)
+    assert m.value() == 0.0
+    with tele.span("sgd", it=1):
+        pass
+    tele.event("round_end", it=1)
+    assert tele.phases.counts["sgd"] == 1  # PhaseClock still accounted
+    assert tele.recorder.tail() == [] and tele.recorder.pending == 0
+    assert tele.render() == "" and tele.registry.snapshot() == {}
+    assert tele.crash_dump(reason="x") is None
+    tele.flush(), tele.close()  # all no-ops, must not raise
+
+
+def test_disabled_telemetry_keeps_explicit_event_log(tmp_path):
+    """Regression: `--telemetry 0 --log-dir ...` must keep producing the
+    event JSONL — the log predates the telemetry plane. Only the metrics
+    half goes null; an explicitly configured spill path keeps a real
+    recorder."""
+    path = str(tmp_path / "ev.jsonl")
+    tele = Telemetry(enabled=False, spill_path=path, spill_batch=2)
+    assert tele.registry is NULL_REGISTRY
+    assert tele.recorder is not NULL_RECORDER
+    tele.event("round_end", it=1, error=0.5)
+    with tele.span("sgd", it=1):
+        pass
+    tele.close()
+    lines = [json.loads(l) for l in open(path).read().splitlines()]
+    assert [e["event"] for e in lines] == ["round_end", "span"]
+    assert tele.render() == "" and tele.registry.snapshot() == {}
+
+
+def test_telemetry_import_is_stdlib_only():
+    """Importing the telemetry package must pull in neither jax nor numpy
+    (it sits on the config/tooling import path and the disabled no-op
+    path; a heavyweight import there would tax every CLI startup)."""
+    code = ("import sys; import biscotti_tpu.telemetry; "
+            "bad = [m for m in ('jax', 'numpy') if m in sys.modules]; "
+            "assert not bad, f'telemetry import dragged in {bad}'")
+    subprocess.run([sys.executable, "-c", code], check=True,
+                   cwd=os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_http_exposition_endpoint():
+    reg = MetricsRegistry()
+    reg.gauge("biscotti_round_height").set(5)
+
+    async def go():
+        server = await serve_metrics(reg.render, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /metrics HTTP/1.0\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        page = await asyncio.wait_for(reader.read(), 5.0)
+        writer.close()
+        server.close()
+        await server.wait_closed()
+        return page.decode()
+
+    page = asyncio.run(go())
+    assert page.startswith("HTTP/1.0 200 OK")
+    assert "text/plain" in page
+    assert "biscotti_round_height 5.0" in page
+
+
+def test_merge_phase_histograms_mixed_enabled_disabled_peers():
+    """Regression: a telemetry-OFF peer's PhaseClock-only snapshot may
+    precede an enabled peer's histogram snapshot for the same phase —
+    the merge must upgrade the entry, not crash, and quantiles must
+    cover the enabled subset while counts cover everyone."""
+    from biscotti_tpu.tools import obs
+
+    disabled = {"phases": {"sgd": {"total_s": 1.0, "calls": 4,
+                                   "mean_s": 0.25}}}
+    enabled = {"metrics": {"biscotti_phase_seconds": {
+        "type": "histogram", "bounds": [0.1, 1.0],
+        "series": [{"labels": {"phase": "sgd"},
+                    "buckets": [3, 1, 0], "sum": 0.5, "count": 4}]}}}
+    for order in ((disabled, enabled), (enabled, disabled)):
+        out = obs.merge_phase_histograms(list(order))
+        assert out["sgd"]["count"] == 8
+        assert out["sgd"]["total_s"] == pytest.approx(1.5)
+        assert out["sgd"]["p50_s"] == 0.1  # from the enabled peer's buckets
+
+
+# ------------------------------------------------- live cluster scraping
+
+N = 4
+DIMS = 50  # creditcard num_params
+
+
+@pytest.fixture(scope="module")
+def key_dir(tmp_path_factory):
+    from biscotti_tpu.tools import keygen
+
+    out = tmp_path_factory.mktemp("keys")
+    keygen.generate(dims=DIMS, nodes=N, out_dir=str(out))
+    return str(out)
+
+
+def _cfg(i, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=N, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=6, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+async def _wait_height(agent, h: int, budget: float = 90.0):
+    deadline = asyncio.get_event_loop().time() + budget
+    while agent.iteration < h:
+        assert asyncio.get_event_loop().time() < deadline, \
+            f"cluster never reached height {h}"
+        await asyncio.sleep(0.05)
+
+
+def test_live_keyed_cluster_scrape_mid_run(key_dir):
+    """Acceptance: a live 4-node dealer-keyed cluster serves per-peer
+    Prometheus snapshots MID-RUN over the `Metrics` RPC; round-height
+    gauges advance between two scrapes; `tools.obs` merges the per-peer
+    snapshots into one cluster table with heights, breaker states, fault
+    tallies and per-phase latency quantiles."""
+    from biscotti_tpu.runtime.peer import PeerAgent
+    from biscotti_tpu.tools import obs
+
+    port = 25500
+    ports = [port + i for i in range(N)]
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, port), key_dir=key_dir)
+                  for i in range(N)]
+        tasks = [asyncio.ensure_future(a.run()) for a in agents]
+        await _wait_height(agents[0], 2)
+        first = await obs.scrape("127.0.0.1", ports, tail=5)
+        await _wait_height(agents[0], 4)
+        second = await obs.scrape("127.0.0.1", ports)
+        # raw RPC: the Prometheus text page itself
+        from biscotti_tpu.runtime import rpc
+
+        rmeta, _ = await rpc.call("127.0.0.1", port, "Metrics", {})
+        results = await asyncio.gather(*tasks)
+        return first, second, rmeta, results
+
+    first, second, rmeta, results = asyncio.run(go())
+    assert not any(s.get("unreachable") for s in first), first
+    m1, m2 = obs.merge_snapshots(first), obs.merge_snapshots(second)
+    assert m1["nodes"] == N and m2["nodes"] == N
+    assert m1["round_height"]["max"] >= 2
+    assert m2["round_height"]["max"] > m1["round_height"]["max"], \
+        "round-height gauges must advance between mid-run scrapes"
+    # the merged per-phase histogram quantiles exist for the hot phases
+    assert "sgd" in m2["phases"] and "p99_s" in m2["phases"]["sgd"]
+    # flight-recorder tail rode along with the first scrape
+    assert all(s.get("events") for s in first)
+    ev = first[0]["events"][-1]
+    assert {"seq", "ts", "mono", "event"} <= set(ev)
+    # the raw exposition page is Prometheus text with the key families
+    page = rmeta["prom"]
+    assert "# TYPE biscotti_round_height gauge" in page
+    assert "biscotti_phase_seconds_bucket" in page
+    assert "biscotti_rpc_frames_total" in page
+    # the human table renders without blowing up
+    table = obs.format_table(m2)
+    assert "cluster: 4 peers" in table and "phase" in table
+    # the run completed normally under scraping: equal chains
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+
+
+def test_metrics_rpc_tail_sanitizes_unserializable_fields():
+    """Regression: the recorder tolerates unserializable field values
+    (spill uses default=str) but the wire codec is strict JSON — the
+    Metrics RPC must sanitize tail events, not die in dispatch."""
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    agent = PeerAgent(_cfg(0, 25560, num_nodes=2))
+    agent.tele.recorder.record("odd", obj=object())
+    reply, _ = asyncio.run(agent._h_metrics({"tail": 5}, {}))
+    json.dumps(reply)  # must survive the strict wire encoding
+    assert reply["events"][-1]["event"] == "odd"
+    assert isinstance(reply["events"][-1]["obj"], str)
+
+
+def test_run_result_keeps_legacy_keys():
+    """Tier-1 guard: the telemetry refactor must not break the eval
+    artifact surface — run() still returns the legacy flat keys next to
+    the new unified `telemetry` snapshot (same schema as the Metrics
+    RPC), and the recorder spill replaces the old per-event trace file
+    with the same JSONL shape plus (mono, seq) stamps."""
+    import tempfile
+
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    port = 25550
+    with tempfile.TemporaryDirectory() as td:
+        logs = [os.path.join(td, f"n{i}.jsonl") for i in range(2)]
+
+        async def go():
+            agents = [PeerAgent(_cfg(i, port, num_nodes=2,
+                                     max_iterations=2),
+                                log_path=logs[i])
+                      for i in range(2)]
+            return await asyncio.gather(*(a.run() for a in agents))
+
+        results = asyncio.run(go())
+        for r in results:
+            for key in ("node", "iterations", "converged", "chain_dump",
+                        "final_error", "counters", "phases", "health",
+                        "faults", "telemetry"):
+                assert key in r, f"run() result lost legacy key {key!r}"
+            snap = r["telemetry"]
+            assert snap["iter"] == r["iterations"]
+            assert snap["phases"] == r["phases"]
+            assert "metrics" in snap and "recorder" in snap
+        for p in logs:
+            lines = [json.loads(l) for l in open(p).read().splitlines()]
+            assert lines, "recorder spill is empty"
+            assert any(e["event"] == "round_end" for e in lines)
+            assert all({"ts", "mono", "seq", "node", "event"} <= set(e)
+                       for e in lines)
