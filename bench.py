@@ -67,6 +67,78 @@ def _progress(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def wire_round_bytes(cfg, w64, accepted, codec="raw64"):
+    """Cluster-wide protocol bytes for ONE round, measured by encoding
+    the actual frames (runtime/wire.py packers + messages.py codec path)
+    with `w64` as the representative delta/model vector:
+
+        num_samples × (num_verifiers × verify + num_miners × submit)
+      + (num_nodes − 1) × block broadcast
+
+    Lossy codecs are applied the way the live runtime applies them —
+    transform BEFORE packing (lossy-before-commit), so the frame sizes
+    here are exactly what the wire plane produces. Crypto tensors
+    (shares, blinds, VSS commitments) are sized from the config and
+    always travel lossless, which is why secure-agg rows compress less
+    than their plain-mode cousins: the crypto dominates and is
+    incompressible by design."""
+    import numpy as np
+
+    from biscotti_tpu.ledger.block import Block, BlockData, Update
+    from biscotti_tpu.ops import secretshare as ss
+    from biscotti_tpu.runtime import codecs as wcodecs
+    from biscotti_tpu.runtime import messages as msgs
+    from biscotti_tpu.runtime import wire as rwire
+
+    wc = wcodecs.get(codec)
+    kw = dict(codec=None if wc.name == wcodecs.RAW else wc.name)
+    d = len(w64)
+    delta, _ = wc.transform(np.asarray(w64, np.float64),
+                            topk_k=max(1, int(round(cfg.wire_topk * d))))
+    gw = wc.transform_dense(np.asarray(w64, np.float64))
+    it = 1
+
+    # worker -> verifier: redacted update, noised copy only (f32 on the
+    # wire since PR before this one; the codec can still zlib it)
+    redacted = Update(source_id=1, iteration=it,
+                      delta=np.zeros(0, np.float64), commitment=b"\0" * 32,
+                      noised_delta=np.asarray(delta, np.float32))
+    vmeta, varrays = rwire.pack_update(redacted)
+    verify = len(msgs.encode("VerifyUpdateKRUM", vmeta, varrays, **kw))
+
+    if cfg.secure_agg:
+        c = ss.num_chunks(d, cfg.poly_size)
+        submit = len(msgs.encode("RegisterSecret", {
+            "iteration": it, "source_id": 1, "miner_index": 0,
+            "commitment": "00" * 32,
+        }, {
+            "share_rows": np.ones((cfg.shares_per_miner, c), np.int64),
+            "blind_rows": np.ones((cfg.shares_per_miner, c, 32), np.uint8),
+            "comms": np.ones((c, cfg.poly_size, 64), np.uint8),
+        }, **kw))
+        blk_updates = [Update(source_id=1, iteration=it,
+                              delta=np.zeros(0, np.float64),
+                              commitment=b"\0" * 32, accepted=True)]
+    else:
+        u = Update(source_id=1, iteration=it, delta=delta,
+                   commitment=b"\0" * 32)
+        umeta, uarrays = rwire.pack_update(u)
+        submit = len(msgs.encode("RegisterUpdate", umeta, uarrays, **kw))
+        blk_updates = [Update(source_id=1, iteration=it, delta=delta,
+                              commitment=b"\0" * 32, accepted=True)]
+
+    blk = Block(data=BlockData(iteration=it, global_w=gw,
+                               deltas=blk_updates * max(1, accepted)),
+                prev_hash=b"\0" * 32,
+                stake_map={i: 10 for i in range(cfg.num_nodes)}).seal()
+    bmeta, barrays = rwire.pack_block(blk)
+    block = len(msgs.encode("RegisterBlock", bmeta, barrays, **kw))
+
+    n_s = cfg.num_samples
+    return int(n_s * (cfg.num_verifiers * verify + cfg.num_miners * submit)
+               + (cfg.num_nodes - 1) * block)
+
+
 def bench_config(name, cfg, device_iters=10, metrics=None):
     import jax
     import numpy as np
@@ -184,6 +256,22 @@ def bench_config(name, cfg, device_iters=10, metrics=None):
         total = device_s + commit_s * (1 + cfg.num_samples)
 
     row["round_total_s"] = round(total, 4)
+    # --- wire data plane: cluster gossip bytes for one round, from the
+    # REAL frame encoders (see wire_round_bytes) — raw64 vs the f32+zlib
+    # operating point, so BENCH_*.json tracks communication, not just
+    # compute (ISSUE 4; NET-SA's bottleneck axis)
+    wire_raw = wire_round_bytes(cfg, delta, accepted, codec="raw64")
+    wire_f32z = wire_round_bytes(cfg, delta, accepted, codec="f32+zlib")
+    row.update({
+        "wire_bytes_per_round": wire_raw,
+        "wire_bytes_per_round_f32_zlib": wire_f32z,
+        "wire_compression_x": round(wire_raw / max(1, wire_f32z), 2),
+    })
+    if metrics is not None:
+        g = metrics.gauge("biscotti_bench_wire_bytes_per_round",
+                          "bench cluster gossip bytes per round")
+        g.set(wire_raw, config=name, codec="raw64")
+        g.set(wire_f32z, config=name, codec="f32+zlib")
     if metrics is not None:
         # every component lands on the telemetry plane too, as one
         # histogram family labeled (config, phase) — rendered to

@@ -194,6 +194,24 @@ class BiscottiConfig:
     # sim.py) so degraded-round semantics agree between sim and live.
     fault_plan: FaultPlan = field(default_factory=FaultPlan)
 
+    # --- wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md) ---
+    # negotiated payload codec for protocol traffic: "raw64" (legacy
+    # float64 frames, the default), "f32"/"bf16" (downcast — applied to
+    # the delta BEFORE commitment/noising/sharing so Pedersen
+    # verification and Shamir recovery stay exact), "zlib" (lossless
+    # deflate), "topk" (sparsification with error-feedback residuals);
+    # stages compose with "+", e.g. "f32+zlib". Crypto-bearing arrays
+    # (int64 shares, commitment tensors) always travel lossless. Peers
+    # advertise capabilities in the RegisterPeer hello and senders fall
+    # back to raw64 for peers that never advertised.
+    wire_codec: str = "raw64"
+    # payloads above this stream as continuation chunks (reassembled in
+    # rpc.FrameStream, MAX_FRAME enforced on the reassembled size);
+    # 0 disables chunking. Only used toward chunk-capable peers.
+    wire_chunk_bytes: int = 4 * 1024 * 1024
+    # fraction of update coordinates the topk stage keeps per round
+    wire_topk: float = 0.05
+
     # --- telemetry plane (biscotti_tpu/telemetry, docs/OBSERVABILITY.md) ---
     # telemetry=False swaps in no-op registry/recorder singletons: spans
     # still feed the legacy PhaseClock totals (pre-telemetry cost), all
@@ -242,6 +260,20 @@ class BiscottiConfig:
                 and self.defense == Defense.TRIMMED_MEAN:
             raise ValueError(
                 f"trim_fraction={self.trim_fraction} must be in [0, 0.5)")
+        # wire-plane validation: a typo'd codec must fail at construction,
+        # not mid-round on the event loop (lazy import keeps this module's
+        # import footprint numpy-free)
+        from biscotti_tpu.runtime.codecs import WireCodecError, parse_codec
+
+        try:
+            parse_codec(self.wire_codec)
+        except WireCodecError as e:
+            raise ValueError(f"wire_codec: {e}") from None
+        if not (0.0 < self.wire_topk <= 1.0):
+            raise ValueError(
+                f"wire_topk={self.wire_topk} must be in (0, 1]")
+        if self.wire_chunk_bytes < 0:
+            raise ValueError("wire_chunk_bytes must be >= 0")
 
     # ------------------------------------------------------------------ derived
 
@@ -381,6 +413,20 @@ class BiscottiConfig:
                        help="P(outbound frame written twice)")
         p.add_argument("--fault-reset", type=float, default=FaultPlan.reset,
                        help="P(connection torn down instead of writing)")
+        p.add_argument("--wire-codec", type=str,
+                       default=BiscottiConfig.wire_codec,
+                       help="payload codec for protocol traffic "
+                            "(raw64 | f32 | bf16 | zlib | topk, composed "
+                            "with '+', e.g. f32+zlib); negotiated per "
+                            "peer, raw64 fallback")
+        p.add_argument("--wire-chunk-bytes", type=int,
+                       default=BiscottiConfig.wire_chunk_bytes,
+                       help="stream payloads above this as continuation "
+                            "chunks (0 disables)")
+        p.add_argument("--wire-topk", type=float,
+                       default=BiscottiConfig.wire_topk,
+                       help="fraction of update coordinates the topk "
+                            "codec stage keeps per round")
         p.add_argument("--telemetry", type=int,
                        default=int(BiscottiConfig.telemetry),
                        help="0 disables the metrics registry + flight "
@@ -436,6 +482,10 @@ class BiscottiConfig:
                                       cls.breaker_threshold),
             breaker_cooldown_s=getattr(ns, "breaker_cooldown_s",
                                        cls.breaker_cooldown_s),
+            wire_codec=getattr(ns, "wire_codec", cls.wire_codec),
+            wire_chunk_bytes=getattr(ns, "wire_chunk_bytes",
+                                     cls.wire_chunk_bytes),
+            wire_topk=getattr(ns, "wire_topk", cls.wire_topk),
             telemetry=bool(getattr(ns, "telemetry", cls.telemetry)),
             metrics_port=getattr(ns, "metrics_port", cls.metrics_port),
             recorder_ring=getattr(ns, "recorder_ring", cls.recorder_ring),

@@ -8,6 +8,13 @@ jitted XLA via the Trainer/ops layers. FedSys (the reference's baseline
 system, SURVEY.md §2.5) is the same runtime in leader-aggregation mode —
 a config flag, not a second codebase.
 
+Wire data plane (`codecs.py`, docs/WIRE_PLANE.md): negotiated per-payload
+codecs — f32/bf16 downcast and top-k sparsification applied to the delta
+BEFORE commitment/noising/sharing so all crypto stays exact, zlib
+lossless framing, raw64 fallback for legacy peers — plus chunked
+streaming for oversized frames and per-frame byte accounting
+(`biscotti_wire_bytes_total{msg_type,direction,codec}`).
+
 Robustness plane (`faults.py`, docs/FAULT_PLANE.md): a seeded
 deterministic fault injector at the transport boundary (per-frame
 drop/delay/duplicate/reset — same seed ⇒ same schedule), retry with

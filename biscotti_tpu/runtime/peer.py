@@ -48,6 +48,7 @@ from biscotti_tpu.models.trainer import Trainer
 from biscotti_tpu.ops import secretshare as ss
 from biscotti_tpu.parallel import roles as R
 from biscotti_tpu.parallel.sim import _poisoned_ids
+from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
 from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import RPCError, StaleError
@@ -224,6 +225,22 @@ class PeerAgent:
 
         self.timeouts = cfg.timeouts  # already-scaled instance may be passed
         self.pool = rpc.Pool()  # persistent multiplexed connections
+        # wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md): the
+        # configured codec pipeline, our advertised capability set, and
+        # what each peer advertised back (absent = assume legacy raw64).
+        # Lossy stages project the delta BEFORE commitment/noising/
+        # sharing (see _worker_flow) and the mint rounds global_w onto
+        # the downcast grid (see _create_block), so the wire itself is
+        # always bit-exact and all crypto survives compression.
+        self.wire = wcodecs.get(cfg.wire_codec)
+        self.caps = wcodecs.capabilities(cfg.wire_codec)
+        self.peer_caps: Dict[int, frozenset] = {}
+        # top-k error-feedback residual (what sparsification dropped,
+        # fed forward into next round's delta) — per-peer state: each
+        # worker owns exactly one, for its own update stream
+        self._ef_residual: Optional[np.ndarray] = None
+        self._topk_k = max(1, int(round(cfg.wire_topk
+                                        * self.trainer.num_params)))
         # per-peer circuit breaker (consecutive transport failures open it;
         # half-open probing re-closes it) — quarantined peers fail fast in
         # _call and are skipped by gossip fan-out instead of burning the
@@ -269,9 +286,13 @@ class PeerAgent:
         if cfg.telemetry:
             # transport + fault-plane instrumentation share the registry
             self.pool.metrics = self.tele.registry
+            self.server.metrics = self.tele.registry
             if self.pool.faults is not None:
                 self.pool.faults.metrics = self.tele.registry
             self.trainer.metrics = self.tele.registry
+        # reply-codec capability set for the RPC server: callers request
+        # a reply codec via `acodec`, granted iff inside OUR caps
+        self.server.caps = self.caps
         self._metrics_server = None
         self._rng = random.Random(cfg.seed * 7919 + self.id)
         # strong refs to fire-and-forget tasks: the loop only keeps weak
@@ -432,6 +453,44 @@ class PeerAgent:
         gossip round on the event loop)."""
         return self._addr_to_pid.get((host, port))
 
+    def _wire_to(self, pid: int) -> Tuple[str, int]:
+        """(codec, chunk_bytes) to use toward `pid`: the configured
+        pipeline when the peer advertised every stage in its hello,
+        else raw64/unchunked — the graceful fallback that keeps legacy
+        (or legacy-configured) peers interoperable."""
+        caps = self.peer_caps.get(pid)
+        if caps is None:
+            return wcodecs.RAW, 0
+        codec = wcodecs.negotiate(self.cfg.wire_codec, caps)
+        chunk = (self.cfg.wire_chunk_bytes
+                 if (wcodecs.CHUNK_CAP in caps
+                     and wcodecs.CHUNK_CAP in self.caps) else 0)
+        return codec, chunk
+
+    def _reply_codec_meta(self, pid: int) -> Dict[str, int]:
+        """Meta keys asking `pid` to code/chunk its REPLY (the
+        Accept-Encoding of this protocol) — set on calls whose reply
+        carries the bulk (GetBlock bodies, RegisterPeer chain
+        adoption). Peers that don't understand them ignore them."""
+        codec, chunk = self._wire_to(pid)
+        out: Dict[str, int] = {}
+        if codec != wcodecs.RAW:
+            out["acodec"] = codec
+        if chunk:
+            out["achunk"] = chunk
+        return out
+
+    def _record_caps(self, pid: int, caps) -> None:
+        """Record a peer's advertised capability set from a hello or a
+        hello reply. A hello WITHOUT a capability set resets the entry
+        to raw64-only: a peer that restarted on a legacy build must
+        stop receiving coded frames immediately, not keep the caps its
+        previous incarnation advertised."""
+        if isinstance(caps, (list, tuple)):
+            self.peer_caps[pid] = frozenset(str(c) for c in caps)
+        else:
+            self.peer_caps[pid] = wcodecs.RAW_CAPS
+
     def _record_peer_ok(self, peer_id: int) -> None:
         """One RPC toward `peer_id` proved the transport healthy: reset its
         failure streak and, if the breaker was tripped, close it."""
@@ -489,8 +548,10 @@ class PeerAgent:
                 if self.health.state(peer_id) == faults.HALF_OPEN:
                     i_am_probe = True  # that allow() claimed the slot
             try:
+                codec, chunk = self._wire_to(peer_id)
                 out = await self.pool.call(host, port, msg_type, meta,
-                                           arrays, timeout, attempt=attempt)
+                                           arrays, timeout, attempt=attempt,
+                                           codec=codec, chunk_bytes=chunk)
                 self._record_peer_ok(peer_id)
                 return out
             except StaleError:
@@ -536,7 +597,9 @@ class PeerAgent:
                     host, port = self.peers[pid]
                     try:
                         bmeta, barrays = await self.pool.call(
-                            host, port, "GetBlock", {"iteration": it},
+                            host, port, "GetBlock",
+                            {"iteration": it,
+                             **self._reply_codec_meta(pid)},
                             timeout=self.timeouts.rpc_s)
                     except Exception:
                         break
@@ -681,6 +744,11 @@ class PeerAgent:
             self.peers[pid] = (meta["host"], int(meta["port"]))
             self._addr_to_pid[self.peers[pid]] = pid
         self.alive.add(pid)
+        # wire-plane negotiation: record the caller's codec capability
+        # set (absent in a legacy hello → it stays raw64-only) and
+        # advertise ours in the reply, so both ends of a first contact
+        # leave knowing what the other can decode
+        self._record_caps(pid, meta.get("codecs"))
         # omit iff our chain would LOSE fork choice against the caller's
         # claimed key — same (weight, length) rule as maybe_adopt, so an
         # isolation survivor padded with empty blocks (long but light)
@@ -690,8 +758,9 @@ class PeerAgent:
         caller_key = (int(meta.get("have_weight", 0)),
                       int(meta.get("have_blocks", 0)))
         if self.chain.adoption_key() <= caller_key:
-            return {"chain_omitted": True}, {}
+            return {"chain_omitted": True, "codecs": sorted(self.caps)}, {}
         cmeta, carrays = wire.pack_chain(self.chain.blocks)
+        cmeta["codecs"] = sorted(self.caps)
         return cmeta, carrays
 
     async def _h_register_block(self, meta, arrays):
@@ -720,7 +789,8 @@ class PeerAgent:
         async def pull():
             try:
                 bmeta, barrays = await self._call(
-                    src, "GetBlock", {"iteration": it},
+                    src, "GetBlock",
+                    {"iteration": it, **self._reply_codec_meta(src)},
                     timeout=self.timeouts.rpc_s)
                 blk = wire.unpack_block(bmeta, barrays)
                 if blk.hash == blk.compute_hash():
@@ -810,14 +880,39 @@ class PeerAgent:
 
             meta, arrays = wire.pack_block(blk)
             meta["rid"] = 0
-            frame = msgs.encode("RegisterBlock", meta, arrays)
+            # encode once PER CODEC GROUP, not per peer: targets that
+            # negotiated the same (codec, chunking) share one frame, so
+            # a homogeneous cluster still pays a single encode while a
+            # mixed cluster's raw64 stragglers get their own legacy copy
+            # (frame bytes, effective codec) per group — the effective
+            # codec (from encode stats) labels the byte accounting, so
+            # a block whose arrays all fell back to raw counts as raw64
+            frames: Dict[Tuple[str, int], Tuple[bytes, str]] = {}
+            group: Dict[int, Tuple[str, int]] = {}
+            for pid in targets:
+                key = self._wire_to(pid)
+                group[pid] = key
+                if key not in frames:
+                    codec, chunk = key
+                    stats: Dict[str, int] = {}
+                    frame = msgs.encode(
+                        "RegisterBlock", meta, arrays,
+                        codec=None if codec == wcodecs.RAW else codec,
+                        chunk_bytes=chunk, stats=stats)
+                    eff = str(stats.get("codec", wcodecs.RAW))
+                    frames[key] = (frame, eff)
+                    wcodecs.observe_ratio(
+                        self.pool.metrics, eff,
+                        stats["raw_bytes"], stats["wire_bytes"])
 
             async def push(pid):
                 host, port = self.peers[pid]
+                frame, eff = frames[group[pid]]
                 try:
                     await self.pool.post(host, port, frame,
                                          timeout=self.timeouts.rpc_s,
-                                         msg_type="RegisterBlock")
+                                         msg_type="RegisterBlock",
+                                         codec=eff)
                 except Exception:
                     self.alive.discard(pid)
                     self._record_peer_fail(pid)
@@ -1434,6 +1529,15 @@ class PeerAgent:
         noise = None
         if cfg.dp_in_model:
             delta = delta + self.trainer.get_noise(it)
+        if self.wire.lossy:
+            # lossy-before-commit (docs/WIRE_PLANE.md): project the delta
+            # onto the codec's representable set NOW — the quantization,
+            # Pedersen commitment, DP noising and Shamir shares below all
+            # operate on the projected values, which the wire then
+            # carries bit-exactly. Top-k keeps an error-feedback residual
+            # that folds what this round dropped into the next delta.
+            delta, self._ef_residual = self.wire.transform(
+                delta, residual=self._ef_residual, topk_k=self._topk_k)
         noised = delta
         if cfg.noising and not cfg.fedsys:
             draw = self._noiser_draw()
@@ -1807,7 +1911,14 @@ class PeerAgent:
         for n in rejected_ids:
             new_stake[n] = max(0, new_stake.get(n, 0) - cfg.stake_unit)
         blk = Block(
-            data=BlockData(iteration=it, global_w=w + agg, deltas=deltas),
+            # mint onto the codec's downcast grid (transform_dense is the
+            # identity for raw64/zlib): the sealed hash then covers values
+            # an f32/bf16 wire carries exactly, so every receiver's hash
+            # check passes regardless of which codec its link negotiated.
+            # Never sparsified — topk applies to per-round deltas only.
+            data=BlockData(iteration=it,
+                           global_w=self.wire.transform_dense(w + agg),
+                           deltas=deltas),
             prev_hash=self.chain.latest_hash(),
             stake_map=new_stake,
         ).seal()
@@ -1879,7 +1990,9 @@ class PeerAgent:
                                             min(3, len(candidates))):
                     try:
                         bmeta, barrays = await self._call(
-                            pid, "GetBlock", {"iteration": it},
+                            pid, "GetBlock",
+                            {"iteration": it,
+                             **self._reply_codec_meta(pid)},
                             timeout=min(5.0, self.timeouts.rpc_s))
                         blk = wire.unpack_block(bmeta, barrays)
                         if blk.hash == blk.compute_hash():
@@ -1954,7 +2067,13 @@ class PeerAgent:
                         pid, "RegisterPeer",
                         {"source_id": self.id, "host": self.peers[self.id][0],
                          "port": self.peers[self.id][1],
-                         "have_weight": w, "have_blocks": ln})
+                         "have_weight": w, "have_blocks": ln,
+                         # wire-plane hello: what we can decode, plus a
+                         # reply-codec ask for the chain body (honoured
+                         # only by capable peers, ignored by legacy ones)
+                         "codecs": sorted(self.caps),
+                         **self._reply_codec_meta(pid)})
+                self._record_caps(pid, cmeta.get("codecs"))
                 blocks = wire.unpack_chain(cmeta, carrays)
                 if blocks and await asyncio.to_thread(
                         self._chain_quorums_ok, blocks):

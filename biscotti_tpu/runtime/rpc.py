@@ -40,6 +40,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import messages as msgs
 
 _U32 = struct.Struct(">I").unpack
@@ -82,6 +83,14 @@ class FrameStream(asyncio.BufferedProtocol):
 
     Back-pressure both ways: ≥8 parsed-but-unconsumed frames pauses the
     transport's reading; writes respect pause_writing via `drain()`.
+
+    Chunked streaming (docs/WIRE_PLANE.md): a payload beginning with
+    messages.CHUNK_MAGIC is a continuation chunk — its body is appended
+    to the in-progress reassembly buffer instead of being queued, and
+    the final chunk (flags bit 0) releases the whole reassembled payload
+    as ONE frame. MAX_FRAME is enforced on the REASSEMBLED size, and the
+    buffer grows with the bytes actually received, so peak allocation
+    tracks real traffic instead of a hostile length prefix.
     """
 
     _SCRATCH = 65536
@@ -96,6 +105,7 @@ class FrameStream(asyncio.BufferedProtocol):
         self._payload: Optional[bytearray] = None
         self._got = 0
         self._need = 0
+        self._reasm: Optional[bytearray] = None  # chunk reassembly buffer
         self._frames: asyncio.Queue = asyncio.Queue()
         self._exc: Optional[Exception] = None
         self._closed = False
@@ -151,6 +161,23 @@ class FrameStream(asyncio.BufferedProtocol):
             return
 
     def _enqueue(self, frame) -> None:
+        if (len(frame) >= msgs.CHUNK_OVERHEAD
+                and bytes(memoryview(frame)[:4]) == msgs.CHUNK_MAGIC):
+            # continuation chunk: accumulate; only the final chunk of the
+            # run surfaces as a frame (cap checked on the reassembled size)
+            buf = self._reasm if self._reasm is not None else bytearray()
+            body = memoryview(frame)[msgs.CHUNK_OVERHEAD:]
+            if len(buf) + len(body) > msgs.MAX_FRAME:
+                self._reasm = None
+                self._protocol_error(
+                    ConnectionError("reassembled frame exceeds cap"))
+                return
+            buf += body
+            if not (frame[4] & msgs.CHUNK_LAST):
+                self._reasm = buf
+                return
+            self._reasm = None
+            frame = buf
         self._frames.put_nowait(frame)
         if (not self._read_paused
                 and self._frames.qsize() >= self._QUEUE_HIGH
@@ -242,6 +269,12 @@ class RPCServer:
         self.handler = handler
         self._server: Optional[asyncio.AbstractServer] = None
         self._conn_tasks: set = set()
+        # wire-plane knobs, set by the owning peer: `caps` bounds which
+        # reply codecs a caller's `acodec` request may select (defaults
+        # to legacy raw64-only so a bare RPCServer behaves like the
+        # seed); `metrics` ticks inbound/outbound byte counters
+        self.caps = wcodecs.RAW_CAPS
+        self.metrics = None
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
@@ -279,6 +312,12 @@ class RPCServer:
                     msg_type, meta, arrays = msgs.decode(payload)
                 except msgs.CodecError:
                     break  # hostile/garbled peer: drop the connection
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        wcodecs.WIRE_BYTES_METRIC,
+                        wcodecs.WIRE_BYTES_HELP).inc(
+                        len(payload), msg_type=msg_type, direction="in",
+                        codec=meta.get("_wire_codec", wcodecs.RAW))
                 t = asyncio.create_task(
                     self._dispatch(msg_type, meta, arrays, stream, write_lock)
                 )
@@ -304,7 +343,39 @@ class RPCServer:
             rmeta, rarrays = {"error": f"internal: {type(e).__name__}: {e}"}, {}
         rmeta = dict(rmeta)
         rmeta["rid"] = rid
-        parts = msgs.encode_parts(msg_type + ".reply", rmeta, rarrays)
+        # reply codec: honour the caller's `acodec`/`achunk` request iff
+        # every stage sits inside OUR advertised capability set (a
+        # raw64-configured peer ignores both — legacy emulation), with a
+        # hard floor on chunk size so a hostile achunk cannot shatter a
+        # reply into per-byte frames
+        codec = wcodecs.negotiate(str(meta.get("acodec") or ""), self.caps)
+        achunk = 0
+        if wcodecs.CHUNK_CAP in self.caps:
+            try:
+                achunk = int(meta.get("achunk", 0) or 0)
+            except (TypeError, ValueError):
+                achunk = 0
+            achunk = 0 if achunk <= 0 else max(achunk, msgs.MIN_CHUNK)
+        stats: Optional[dict] = {} if self.metrics is not None else None
+        try:
+            parts = msgs.encode_parts(
+                msg_type + ".reply", rmeta, rarrays,
+                codec=None if codec == wcodecs.RAW else codec,
+                chunk_bytes=achunk, stats=stats)
+        except msgs.CodecError:
+            # a coded reply that fails to encode must not eat the reply:
+            # fall back to the legacy raw frame
+            parts = msgs.encode_parts(msg_type + ".reply", rmeta, rarrays,
+                                      stats=stats)
+            codec = wcodecs.RAW
+        if self.metrics is not None:
+            eff = stats.get("codec", wcodecs.RAW)
+            self.metrics.counter(wcodecs.WIRE_BYTES_METRIC,
+                                 wcodecs.WIRE_BYTES_HELP).inc(
+                stats["wire_bytes"], msg_type=msg_type + ".reply",
+                direction="out", codec=eff)
+            wcodecs.observe_ratio(self.metrics, eff,
+                                  stats["raw_bytes"], stats["wire_bytes"])
         async with write_lock:
             try:
                 stream.write_parts(parts)
@@ -322,6 +393,7 @@ class _Conn:
         self.next_rid = 1
         self.write_lock = asyncio.Lock()
         self.sending = 0  # in-flight fire-and-forget writes (see _send)
+        self.metrics = None  # set by Pool: inbound reply byte accounting
         self.reader_task = asyncio.create_task(self._read_loop())
 
     async def _read_loop(self) -> None:
@@ -329,9 +401,15 @@ class _Conn:
             while True:
                 payload = await self.stream.next_frame()
                 try:
-                    _, rmeta, rarrays = msgs.decode(payload)
+                    mtype, rmeta, rarrays = msgs.decode(payload)
                 except msgs.CodecError:
                     break  # garbled peer: tear the connection down
+                if self.metrics is not None:
+                    self.metrics.counter(
+                        wcodecs.WIRE_BYTES_METRIC,
+                        wcodecs.WIRE_BYTES_HELP).inc(
+                        len(payload), msg_type=mtype, direction="in",
+                        codec=rmeta.get("_wire_codec", wcodecs.RAW))
                 fut = self.pending.pop(rmeta.get("rid"), None)
                 if fut is not None and not fut.done():
                     fut.set_result((rmeta, rarrays))
@@ -405,17 +483,25 @@ class _Conn:
         N=100 that lost the minted block for every peer beyond the cap."""
         await self._send_parts([frame], timeout, fault=fault)
 
-    async def roundtrip(self, msg_type, meta, arrays, timeout, fault=None):
+    async def roundtrip(self, msg_type, meta, arrays, timeout, fault=None,
+                        codec=None, chunk_bytes=0, account=None):
         rid = self.next_rid
         self.next_rid += 1
         fut = asyncio.get_running_loop().create_future()
         self.pending[rid] = fut
         meta2 = dict(meta or {})
         meta2["rid"] = rid
-        parts = msgs.encode_parts(msg_type, meta2, arrays)
+        stats: Optional[dict] = {} if account is not None else None
+        parts = msgs.encode_parts(msg_type, meta2, arrays, codec=codec,
+                                  chunk_bytes=chunk_bytes, stats=stats)
         deadline = asyncio.get_running_loop().time() + timeout
         try:
             await self._send_parts(parts, timeout, fault=fault)
+            if account is not None:
+                # counted once the transport accepted the frame (an
+                # injected drop still counts: the peer DID spend the
+                # encode and hand the bytes over)
+                account(stats)
             remaining = max(0.001, deadline - asyncio.get_running_loop().time())
             return await asyncio.wait_for(fut, remaining)
         finally:
@@ -489,6 +575,7 @@ class Pool:
 
     async def _dial(self, key: Tuple[str, int]) -> _Conn:
         conn = _Conn(await open_frame_stream(*key))
+        conn.metrics = self.metrics
         self._conns[key] = conn
         self._conns.move_to_end(key)
         self._evict(exempt=key)
@@ -510,10 +597,32 @@ class Pool:
             self._dialing[key] = task
         return await asyncio.wait_for(asyncio.shield(task), timeout)
 
+    def _account_out(self, msg_type: str):
+        """Outbound byte-accounting closure for one call (None when
+        telemetry is off): wire bytes counter + compression ratio,
+        labeled with the frame's EFFECTIVE codec from encode stats (a
+        frame whose arrays all fell back to raw counts as raw64, so
+        both directions and the ratio histogram agree)."""
+        m = self.metrics
+        if m is None:
+            return None
+
+        def account(stats: dict) -> None:
+            eff = stats.get("codec", wcodecs.RAW)
+            m.counter(wcodecs.WIRE_BYTES_METRIC,
+                      wcodecs.WIRE_BYTES_HELP).inc(
+                stats["wire_bytes"], msg_type=msg_type, direction="out",
+                codec=eff)
+            wcodecs.observe_ratio(m, eff, stats["raw_bytes"],
+                                  stats["wire_bytes"])
+
+        return account
+
     async def call(self, host: str, port: int, msg_type: str,
                    meta: Dict[str, Any] | None = None,
                    arrays: Dict[str, np.ndarray] | None = None,
-                   timeout: float = 120.0, attempt: int = 0):
+                   timeout: float = 120.0, attempt: int = 0,
+                   codec: str = wcodecs.RAW, chunk_bytes: int = 0):
         # one deadline covers dial + send + reply: dialing must not grant
         # the roundtrip a second full budget
         loop = asyncio.get_running_loop()
@@ -536,8 +645,11 @@ class Pool:
                           "outbound RPC frames by method and kind").inc(
                     msg_type=msg_type, kind="call")
             remaining = max(0.001, deadline - loop.time())
-            rmeta, rarrays = await conn.roundtrip(msg_type, meta, arrays,
-                                                  remaining, fault=fault)
+            rmeta, rarrays = await conn.roundtrip(
+                msg_type, meta, arrays, remaining, fault=fault,
+                codec=None if codec == wcodecs.RAW else codec,
+                chunk_bytes=chunk_bytes,
+                account=self._account_out(msg_type))
         except BaseException as e:
             # cancellation is the CALLER giving up (shutdown, a superseding
             # deadline), not the transport failing — keep it out of the
@@ -563,12 +675,13 @@ class Pool:
 
     async def post(self, host: str, port: int, frame: bytes,
                    timeout: float = 120.0, msg_type: str = "post",
-                   attempt: int = 0) -> None:
+                   attempt: int = 0, codec: str = wcodecs.RAW) -> None:
         """Fire-and-forget a PRE-ENCODED frame (rid 0: any reply is dropped
         by the reader). Lets a broadcast encode its payload once and write
         the same bytes to every peer — at N=100 the per-peer re-encode of a
-        multi-MB block was the event loop's dominant cost. `msg_type` only
-        keys the fault plane's draw (the frame already carries its type)."""
+        multi-MB block was the event loop's dominant cost. `msg_type` and
+        `codec` only key the fault plane's draw and the byte accounting
+        (the frame already carries both)."""
         loop = asyncio.get_running_loop()
         deadline = loop.time() + timeout
         if self.latency is not None:
@@ -582,6 +695,9 @@ class Pool:
             self.metrics.counter("biscotti_rpc_frames_total",
                                  "outbound RPC frames by method and kind"
                                  ).inc(msg_type=msg_type, kind="post")
+            self.metrics.counter(wcodecs.WIRE_BYTES_METRIC,
+                                 wcodecs.WIRE_BYTES_HELP).inc(
+                len(frame), msg_type=msg_type, direction="out", codec=codec)
         await conn._send(frame, max(0.001, deadline - loop.time()),
                          fault=fault)
 

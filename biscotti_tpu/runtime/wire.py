@@ -7,11 +7,24 @@ updates need explicit packers. All byte fields travel as hex in metadata.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from biscotti_tpu.ledger.block import Block, BlockData, Update
+
+
+def _as_f64(a: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    """float64 view of a decoded payload, ZERO-COPY when the wire already
+    delivered float64 (the common case: messages.decode hands back
+    read-only views into the frame buffer, and an `asarray(..., f64)`
+    on a differently-typed array is the only thing that should ever
+    copy). Codec-decoded arrays (runtime/codecs.py) arrive as float64
+    already, so coded frames stay on the no-copy path too."""
+    if a is None:
+        return None
+    a = np.asarray(a)
+    return a if a.dtype == np.float64 else a.astype(np.float64)
 
 
 def pack_update(u: Update, prefix: str = "u") -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
@@ -38,11 +51,11 @@ def unpack_update(meta: Dict[str, Any], arrays: Dict[str, np.ndarray],
     return Update(
         source_id=int(meta["source_id"]),
         iteration=int(meta["iteration"]),
-        delta=np.asarray(arrays[f"{prefix}.delta"], dtype=np.float64),
+        delta=_as_f64(arrays[f"{prefix}.delta"]),
         commitment=bytes.fromhex(meta.get("commitment", "")),
-        noise=np.asarray(arrays[f"{prefix}.noise"], np.float64)
+        noise=_as_f64(arrays[f"{prefix}.noise"])
         if meta.get("has_noise") else None,
-        noised_delta=np.asarray(arrays[f"{prefix}.noised"], np.float64)
+        noised_delta=_as_f64(arrays[f"{prefix}.noised"])
         if meta.get("has_noised") else None,
         accepted=bool(meta.get("accepted", False)),
         signatures=[bytes.fromhex(s) for s in meta.get("signatures", [])],
@@ -76,7 +89,7 @@ def unpack_block(meta: Dict[str, Any], arrays: Dict[str, np.ndarray]) -> Block:
     blk = Block(
         data=BlockData(
             iteration=int(meta["iteration"]),
-            global_w=np.asarray(arrays["global_w"], dtype=np.float64),
+            global_w=_as_f64(arrays["global_w"]),
             deltas=deltas,
         ),
         prev_hash=bytes.fromhex(meta["prev_hash"]),

@@ -81,6 +81,10 @@ def main(argv=None) -> int:
     ap.add_argument("--rpc-retries", type=int, default=2)
     ap.add_argument("--breaker-threshold", type=int, default=3)
     ap.add_argument("--breaker-cooldown-s", type=float, default=2.0)
+    ap.add_argument("--codec", default="raw64",
+                    help="wire codec for the whole cluster (e.g. "
+                         "f32+zlib) so chaos schedules also exercise "
+                         "compressed/chunked frames")
     ns = ap.parse_args(argv)
 
     import jax
@@ -106,7 +110,8 @@ def main(argv=None) -> int:
             sample_percent=1.0, batch_size=8, timeouts=fast,
             rpc_retries=ns.rpc_retries,
             breaker_threshold=ns.breaker_threshold,
-            breaker_cooldown_s=ns.breaker_cooldown_s, fault_plan=plan)
+            breaker_cooldown_s=ns.breaker_cooldown_s, fault_plan=plan,
+            wire_codec=ns.codec)
 
     async def go():
         agents = [PeerAgent(cfg(i)) for i in range(ns.nodes)]
@@ -122,6 +127,7 @@ def main(argv=None) -> int:
     cluster = cluster_table(results)
     report = {
         "nodes": ns.nodes, "rounds": ns.rounds,
+        "wire_codec": ns.codec,
         "fault_plan": {"seed": plan.seed, "drop": plan.drop,
                        "delay": plan.delay, "delay_s": plan.delay_s,
                        "duplicate": plan.duplicate, "reset": plan.reset},

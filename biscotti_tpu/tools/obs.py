@@ -77,6 +77,39 @@ def merge_phase_histograms(snaps: List[Dict]) -> Dict[str, Dict]:
     return out
 
 
+def merge_wire(snaps: List[Dict]) -> Dict:
+    """Merge every peer's `biscotti_wire_bytes_total` counters into one
+    cluster traffic table: totals per direction, outbound split by codec
+    and by message type. Outbound is the attribution axis (summing both
+    directions would double-count every loopback frame)."""
+    out = {"out_bytes": 0, "in_bytes": 0,
+           "out_by_codec": {}, "out_by_msg_type": {}}
+    for snap in snaps:
+        fam = (snap.get("metrics") or {}).get("biscotti_wire_bytes_total")
+        for row in (fam or {}).get("series", []):
+            labels = row.get("labels", {})
+            v = int(row.get("value", 0))
+            if labels.get("direction") == "out":
+                out["out_bytes"] += v
+                codec = labels.get("codec", "?")
+                mt = labels.get("msg_type", "?")
+                out["out_by_codec"][codec] = \
+                    out["out_by_codec"].get(codec, 0) + v
+                out["out_by_msg_type"][mt] = \
+                    out["out_by_msg_type"].get(mt, 0) + v
+            elif labels.get("direction") == "in":
+                out["in_bytes"] += v
+    return out
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GB"
+
+
 def merge_snapshots(snaps: List[Dict]) -> Dict:
     """One cluster table from per-peer telemetry snapshots (the schema
     `PeerAgent.telemetry_snapshot()` / the `Metrics` RPC serve)."""
@@ -106,6 +139,10 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
             "faults": dict(s.get("faults") or {}),
         })
     hs = list(heights.values()) or [0]
+    wire = merge_wire(snaps)
+    # bytes/round: cluster outbound traffic amortized over settled
+    # rounds — THE comms-cost number the wire plane exists to shrink
+    wire["bytes_per_round"] = round(wire["out_bytes"] / max(1, max(hs)), 1)
     return {
         "nodes": len(snaps),
         "round_height": {"min": min(hs), "max": max(hs),
@@ -113,6 +150,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "breakers_open": breakers_open,
         "faults": faults,
         "counters": counters,
+        "wire": wire,
         "phases": merge_phase_histograms(snaps),
         "per_node": per_node,
     }
@@ -139,6 +177,16 @@ def format_table(merged: Dict) -> str:
         lines.append(f"{n['node']!s:>5} {n['iter']:>5} "
                      f"{str(n['converged'])[:1]:>5} {n['breaker_opens']:>6} "
                      f"{n['fast_fails']:>8}  {' '.join(extra)}")
+    wire = merged.get("wire") or {}
+    if wire.get("out_bytes") or wire.get("in_bytes"):
+        by_codec = ", ".join(
+            f"{k}={_fmt_bytes(v)}"
+            for k, v in sorted(wire["out_by_codec"].items(),
+                               key=lambda kv: -kv[1]))
+        lines += ["", f"wire: out {_fmt_bytes(wire['out_bytes'])}  "
+                      f"in {_fmt_bytes(wire['in_bytes'])}  "
+                      f"({_fmt_bytes(wire.get('bytes_per_round', 0))}/round)"
+                      + (f"   [{by_codec}]" if by_codec else "")]
     if merged["faults"]:
         lines += ["", "injected faults (cluster): " + ", ".join(
             f"{k}={v}" for k, v in sorted(merged["faults"].items()))]

@@ -85,6 +85,11 @@ def main(argv=None) -> int:
         for name, row in quantiles.items()
     }
 
+    # comms cost beside the compute phases: the wire table straight off
+    # obs.merge_snapshots — the ONE cluster-readout definition shared
+    # with the live scraper and the chaos report, bytes/round included
+    wire = obs.merge_snapshots(snaps)["wire"]
+
     dumps = [r["chain_dump"] for r in results]
     summary = {
         "experiment": "cost_breakdown",
@@ -96,6 +101,9 @@ def main(argv=None) -> int:
         # per-phase latency quantiles from the merged telemetry histograms
         # (p50/p99 — the distribution the total_s means hide)
         "phase_quantiles": quantiles,
+        # comms-bytes row next to the phase table: a round's cost is
+        # compute AND bytes on the wire (the latter dominates at scale)
+        "wire": wire,
         "device_trace": args.trace_dir or None,
     }
     print(json.dumps(summary))
@@ -107,6 +115,10 @@ def main(argv=None) -> int:
         for name, agg in summary["phases"].items():
             f.write(f"{name},{agg['total_s']},{agg['calls']},"
                     f"{agg['s_per_call']}\n")
+        f.write("\nmetric,value\n")
+        f.write(f"wire_out_bytes,{wire['out_bytes']}\n")
+        f.write(f"wire_in_bytes,{wire['in_bytes']}\n")
+        f.write(f"wire_bytes_per_round,{wire['bytes_per_round']}\n")
     return 0 if summary["chains_equal"] else 1
 
 

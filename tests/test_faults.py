@@ -279,7 +279,8 @@ def test_call_retries_transport_failures_then_succeeds():
     agent = PeerAgent(_cfg(0, 2, 25300))
     attempts = []
 
-    async def flaky(host, port, msg_type, meta, arrays, timeout, attempt=0):
+    async def flaky(host, port, msg_type, meta, arrays, timeout,
+                    attempt=0, **kw):
         attempts.append(attempt)
         if len(attempts) < 3:
             raise ConnectionError("synthetic transport failure")
@@ -303,7 +304,8 @@ def test_call_does_not_retry_protocol_errors():
     agent = PeerAgent(_cfg(0, 2, 25300))
     calls = []
 
-    async def reject(host, port, msg_type, meta, arrays, timeout, attempt=0):
+    async def reject(host, port, msg_type, meta, arrays, timeout,
+                     attempt=0, **kw):
         calls.append(attempt)
         raise RPCError("rejected by defense")
 
@@ -318,7 +320,8 @@ def test_call_does_not_retry_protocol_errors():
 def test_call_fails_fast_when_breaker_open():
     agent = PeerAgent(_cfg(0, 2, 25300, breaker_cooldown_s=60.0))
 
-    async def boom(host, port, msg_type, meta, arrays, timeout, attempt=0):
+    async def boom(host, port, msg_type, meta, arrays, timeout,
+                   attempt=0, **kw):
         raise ConnectionError("down")
 
     agent.pool.call = boom

@@ -6,11 +6,13 @@ means any peer can send anything; a crash here is a one-packet DoS
 HANDLER layer above it)."""
 
 import asyncio
+import struct
 
 import numpy as np
 import pytest
 
 from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+from biscotti_tpu.runtime import messages as msgs
 from biscotti_tpu.runtime import rpc
 from biscotti_tpu.runtime.peer import PeerAgent
 
@@ -95,3 +97,41 @@ def test_agent_survives_hostile_rpcs_and_still_serves():
 
     errors = asyncio.run(go())
     assert errors > 0  # hostile calls were refused, not silently accepted
+
+
+def _frame_with_payload(total: int) -> bytes:
+    """One encoded frame whose PAYLOAD (bytes after the length prefix)
+    is exactly `total` bytes, padded via a meta string."""
+    probe = msgs.encode("T", {"pad": ""})
+    overhead = len(probe) - 4  # payload size with empty pad
+    frame = msgs.encode("T", {"pad": "x" * (total - overhead)})
+    assert len(frame) - 4 == total
+    return frame
+
+
+def test_max_frame_bound_symmetric_encoder_vs_reader(monkeypatch):
+    """The encoder and FrameStream share ONE bound (payload <= MAX_FRAME):
+    a maximal frame produced by one side is accepted by the other. The
+    seed rejected at `total + 4 > MAX_FRAME` on encode but `n > MAX_FRAME`
+    on read — a 4-byte asymmetry this pins down forever."""
+    monkeypatch.setattr(msgs, "MAX_FRAME", 8192)
+
+    # maximal frame: encoder produces it, reader accepts and decodes it
+    frame = _frame_with_payload(8192)
+    fs = rpc.FrameStream()
+    fs._acc += frame
+    fs._drain_acc()
+    assert fs._exc is None
+    payload = fs._frames.get_nowait()
+    assert len(payload) == 8192
+    mt, meta, _ = msgs.decode(payload)
+    assert mt == "T"
+
+    # one byte past the bound: the ENCODER refuses…
+    with pytest.raises(msgs.CodecError):
+        msgs.encode("T", {"pad": "x" * 8192})
+    # …and so does the READER, on a hand-built hostile prefix
+    fs2 = rpc.FrameStream()
+    fs2._acc += struct.pack(">I", 8193) + b"\0" * 16
+    fs2._drain_acc()
+    assert fs2._exc is not None

@@ -1,0 +1,394 @@
+"""Wire data plane: codec round-trips, negotiation/fallback, chunked
+streaming, byte accounting, and hostile-input rejection
+(runtime/codecs.py + messages.py + rpc.py, docs/WIRE_PLANE.md).
+
+The load-bearing invariant everywhere: the WIRE is always bit-exact —
+all lossiness happens in the protocol-plane `transform` BEFORE
+commitment — so decode(encode(transform(x))) == transform(x) to the bit,
+and crypto-bearing arrays travel verbatim.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from biscotti_tpu.ledger.block import Update
+from biscotti_tpu.runtime import codecs as wcodecs
+from biscotti_tpu.runtime import messages as msgs
+from biscotti_tpu.runtime import rpc, wire
+
+pytestmark = pytest.mark.codec
+
+CODECS = ["zlib", "f32", "bf16", "topk", "f32+zlib", "bf16+zlib",
+          "topk+f32+zlib"]
+
+
+def _roundtrip(name, arrays, codec):
+    frame = msgs.encode(name, {"k": 1}, arrays, codec=codec)
+    mt, meta, out = msgs.decode(frame[4:])
+    assert mt == name
+    return meta, out, len(frame)
+
+
+# ------------------------------------------------------------ round-trips
+
+@pytest.mark.parametrize("codec", CODECS)
+def test_transform_then_wire_is_bit_exact(codec):
+    rng = np.random.default_rng(7)
+    x = np.trunc(rng.normal(0, 0.02, 4096) * 1e4) / 1e4  # quantized delta
+    wc = wcodecs.get(codec)
+    y, _ = wc.transform(x, topk_k=200)
+    meta, out, _ = _roundtrip("T", {"d": y}, codec)
+    assert out["d"].dtype == np.float64
+    assert np.array_equal(out["d"], y), codec
+    # idempotence: the transform is a projection
+    y2, _ = wc.transform(y, topk_k=200)
+    assert np.array_equal(y2, y), codec
+    if not wc.lossy:
+        assert np.array_equal(y, x)
+
+
+@pytest.mark.parametrize("codec", CODECS)
+def test_full_precision_payload_survives_coded_frame(codec):
+    """A payload that never went through the lossy transform (e.g. a
+    block minted by a raw64 peer) must cross a codec-negotiated link
+    unchanged: downcast stages skip when inexact, zlib is lossless."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=2048)  # full-entropy f64: f32/bf16 NOT exact
+    _, out, _ = _roundtrip("T", {"d": x}, codec)
+    assert np.array_equal(out["d"], x), codec
+
+
+def test_crypto_arrays_always_travel_raw():
+    rng = np.random.default_rng(3)
+    arrays = {
+        "share_rows": rng.integers(0, 2**62, (4, 16)).astype(np.int64),
+        "comms": rng.integers(0, 256, (16, 10, 64)).astype(np.uint8),
+        "d": np.trunc(rng.normal(0, 1, 512) * 1e4) / 1e4,
+    }
+    parts = msgs.encode_parts("T", {}, arrays, codec="f32+zlib")
+    header = __import__("json").loads(bytes(parts[2]).decode())
+    descs = {d["name"]: d for d in header["arrays"]}
+    assert "codec" not in descs["share_rows"]  # int64: verbatim
+    assert "codec" not in descs["comms"]  # uint8: verbatim
+    assert descs["d"].get("codec")  # float payload: coded
+    _, out, _ = _roundtrip("T", arrays, "f32+zlib")
+    for k, v in arrays.items():
+        assert np.array_equal(out[k], v), k
+
+
+def test_codec_roundtrip_property():
+    hypothesis = pytest.importorskip(
+        "hypothesis", reason="property-based deps absent in this env")
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        codec=st.sampled_from(CODECS),
+        d=st.integers(min_value=1, max_value=300),
+        k=st.integers(min_value=1, max_value=64),
+        scale=st.sampled_from([1e-6, 1e-2, 1.0, 1e4]),
+        seed=st.integers(min_value=0, max_value=2**31 - 1),
+    )
+    def check(codec, d, k, scale, seed):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(0, scale, d)
+        x[rng.random(d) < 0.3] = 0.0  # realistic zero support
+        wc = wcodecs.get(codec)
+        y, res = wc.transform(x, topk_k=k)
+        _, out, _ = _roundtrip("T", {"d": y}, codec)
+        assert np.array_equal(out["d"], y)
+        if wc.sparsify:
+            # error feedback: kept + residual == input, exactly what
+            # the next round's delta gets back
+            assert res is not None and res.shape == x.shape
+        # a full-precision payload is never altered by the wire
+        _, out2, _ = _roundtrip("T", {"d": x}, codec)
+        assert np.array_equal(out2["d"], x)
+
+    check()
+
+
+def test_unpack_update_zero_copy_on_matching_dtype():
+    d = np.arange(64, dtype=np.float64)
+    meta, arrays = wire.pack_update(
+        Update(source_id=1, iteration=2, delta=d, commitment=b"\0" * 32))
+    u = wire.unpack_update(meta, arrays)
+    assert np.shares_memory(u.delta, arrays["u.delta"])  # no decode copy
+    u32 = wire.unpack_update(meta, {"u.delta": d.astype(np.float32)})
+    assert u32.delta.dtype == np.float64  # converted, not aliased
+
+
+# ------------------------------------------------------- hostile payloads
+
+def test_zlib_bomb_rejected():
+    import json
+    import zlib
+
+    # a few KB of compressed zeros claiming a shape whose decoded size
+    # blows past MAX_FRAME: refused BEFORE any inflate is attempted
+    bomb = zlib.compress(b"\0" * 65536, 9)
+    header = json.dumps({
+        "type": "T", "meta": {}, "codec": "zlib",
+        "arrays": [{"name": "d", "dtype": "float64",
+                    "shape": [msgs.MAX_FRAME], "codec": "zlib",
+                    "nbytes": len(bomb)}],
+    }, separators=(",", ":")).encode()
+    payload = struct.pack(">I", len(header)) + header + bomb
+    with pytest.raises(msgs.CodecError):
+        msgs.decode(payload)
+
+    # a stream that inflates past what its declared shape needs
+    header2 = json.dumps({
+        "type": "T", "meta": {}, "codec": "zlib",
+        "arrays": [{"name": "d", "dtype": "float64", "shape": [8],
+                    "codec": "zlib", "nbytes": len(bomb)}],
+    }, separators=(",", ":")).encode()
+    payload2 = struct.pack(">I", len(header2)) + header2 + bomb
+    with pytest.raises(msgs.CodecError):
+        msgs.decode(payload2)
+
+
+def test_hostile_coded_frames_rejected_not_crash():
+    good = msgs.encode("T", {}, {"d": np.ones(32)}, codec="topk+f32+zlib")
+    # flip bytes through the coded section: every corruption must raise
+    # CodecError (or decode to something), never segfault/hang
+    for off in range(40, min(len(good), 120), 7):
+        bad = bytearray(good[4:])
+        bad[off] ^= 0xFF
+        try:
+            msgs.decode(bytes(bad))
+        except msgs.CodecError:
+            pass
+
+    # unknown / malformed codec tags
+    import json
+    for tag in ["nope", "f32+f32", "f32+bf16", "", "raw64+zlib"]:
+        header = json.dumps({
+            "type": "T", "meta": {},
+            "arrays": [{"name": "d", "dtype": "float64", "shape": [4],
+                        "codec": tag, "nbytes": 8}],
+        }, separators=(",", ":")).encode()
+        payload = struct.pack(">I", len(header)) + header + b"\0" * 8
+        with pytest.raises(msgs.CodecError):
+            msgs.decode(payload)
+
+
+def test_sparse_indices_validated():
+    import json
+
+    # duplicate / out-of-range indices must be refused (a hostile scatter
+    # could otherwise mis-shape the decoded update)
+    k = 3
+    packed = (struct.pack("<Q", k)
+              + np.array([5, 5, 2], "<i4").tobytes()
+              + np.zeros(3, "<f8").tobytes())
+    header = json.dumps({
+        "type": "T", "meta": {},
+        "arrays": [{"name": "d", "dtype": "float64", "shape": [8],
+                    "codec": "topk", "nbytes": len(packed)}],
+    }, separators=(",", ":")).encode()
+    payload = struct.pack(">I", len(header)) + header + packed
+    with pytest.raises(msgs.CodecError):
+        msgs.decode(payload)
+
+
+# ------------------------------------------------------ chunked streaming
+
+def test_chunk_split_and_reassembly_unit():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=40_000)  # ~320 KB, incompressible
+    blob = msgs.encode("T", {"n": 1}, {"d": x}, chunk_bytes=65536)
+    # multiple chunk frames on the wire…
+    off, n_frames = 0, 0
+    while off < len(blob):
+        (ln,) = struct.unpack(">I", blob[off: off + 4])
+        off += 4 + ln
+        n_frames += 1
+    assert n_frames > 1
+    # …that FrameStream reassembles into ONE frame
+    fs = rpc.FrameStream()
+    fs._acc += blob
+    fs._drain_acc()
+    payload = fs._frames.get_nowait()
+    assert fs._frames.empty()
+    mt, meta, out = msgs.decode(payload)
+    assert mt == "T" and np.array_equal(out["d"], x)
+
+
+def test_chunk_reassembly_enforces_max_frame(monkeypatch):
+    monkeypatch.setattr(msgs, "MAX_FRAME", 10_000)
+    fs = rpc.FrameStream()
+    chunk = msgs.CHUNK_MAGIC + b"\x00" + b"x" * 6000
+    fs._enqueue(chunk)
+    assert fs._exc is None
+    fs._enqueue(chunk)  # reassembled total 12 KB > cap
+    assert fs._exc is not None
+    assert fs._frames.empty()
+
+
+def test_chunked_rpc_roundtrip_live():
+    """Request AND reply above the chunk threshold over a real loopback
+    connection: client chunks via chunk_bytes, server honours achunk."""
+    rng = np.random.default_rng(9)
+    big = rng.normal(size=60_000)  # ~480 KB each way
+
+    async def handler(msg_type, meta, arrays):
+        return {"ok": 1}, {"echo": arrays["d"]}
+
+    async def go():
+        server = rpc.RPCServer("127.0.0.1", 27490, handler)
+        server.caps = wcodecs.FULL_CAPS
+        await server.start()
+        pool = rpc.Pool()
+        try:
+            rmeta, rarrays = await pool.call(
+                "127.0.0.1", 27490, "Big",
+                {"achunk": 65536}, {"d": big},
+                timeout=20.0, chunk_bytes=65536)
+            return rmeta, rarrays
+        finally:
+            pool.close()
+            await server.stop()
+
+    rmeta, rarrays = asyncio.run(go())
+    assert rmeta["ok"] == 1
+    assert np.array_equal(rarrays["echo"], big)
+
+
+# ------------------------------------------------- live cluster behavior
+
+def _wire_out_by_codec(results, msg_type=None):
+    tot = {}
+    for r in results:
+        fam = r["telemetry"]["metrics"].get("biscotti_wire_bytes_total", {})
+        for row in fam.get("series", []):
+            lb = row["labels"]
+            if lb.get("direction") != "out":
+                continue
+            if msg_type is not None and lb.get("msg_type") != msg_type:
+                continue
+            tot[lb.get("codec")] = tot.get(lb.get("codec"), 0) \
+                + row["value"]
+    return tot
+
+
+def _cluster(port, dataset, codecs_by_node, iters=2, **kw):
+    from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    fast = Timeouts(update_s=6.0, block_s=30.0, krum_s=6.0, share_s=6.0,
+                    rpc_s=8.0)
+    n = len(codecs_by_node)
+    base = dict(num_nodes=n, dataset=dataset, base_port=port,
+                num_verifiers=1, num_miners=1, num_noisers=1,
+                secure_agg=True, noising=True, verification=True,
+                defense=Defense.KRUM, max_iterations=iters,
+                convergence_error=0.0, sample_percent=1.0, batch_size=8,
+                timeouts=fast, seed=3)
+    base.update(kw)
+    cfgs = [BiscottiConfig(node_id=i, wire_codec=codecs_by_node[i], **base)
+            for i in range(n)]
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    return asyncio.run(go())
+
+
+def test_mixed_cluster_interop_raw64_peer_converges():
+    """One raw64-only peer among codec-enabled peers: negotiation must
+    fall back per-link, crypto must survive, chains must agree."""
+    agents, results = _cluster(
+        27410, "creditcard", ["raw64", "f32+zlib", "f32+zlib", "f32+zlib"])
+    dumps = [r["chain_dump"] for r in results]
+    assert all(d == dumps[0] for d in dumps)
+    assert sum(a.counters.get("submission_rejected", 0)
+               for a in agents) == 0
+    assert sum(a.counters.get("secret_registered", 0) for a in agents) > 0
+    # the legacy peer sent ONLY raw64 frames…
+    raw_only = _wire_out_by_codec([results[0]])
+    assert set(raw_only) == {"raw64"} and raw_only["raw64"] > 0
+    # …codec peers spoke BOTH dialects: raw64 toward the legacy peer,
+    # f32+zlib among themselves
+    coded = _wire_out_by_codec(results[1:])
+    assert coded.get("f32+zlib", 0) > 0
+    assert coded.get("raw64", 0) > 0
+
+
+def test_gossip_compression_vs_raw64_mnist():
+    """f32+zlib vs raw64 on the SAME mnist config: block-gossip bytes
+    per round must shrink substantially (>= 2x here; the mnist_cnn
+    acceptance run below asserts the ISSUE's >= 3x), with secure-agg
+    recovery and commitment verification intact in both runs."""
+    _, res_raw = _cluster(27420, "mnist", ["raw64"] * 4, noising=False)
+    agents, res_cod = _cluster(27430, "mnist", ["f32+zlib"] * 4,
+                               noising=False)
+    for results in (res_raw, res_cod):
+        dumps = [r["chain_dump"] for r in results]
+        assert all(d == dumps[0] for d in dumps)
+    assert sum(a.counters.get("submission_rejected", 0)
+               for a in agents) == 0
+    assert sum(a.counters.get("secret_registered", 0) for a in agents) > 0
+    gossip_raw = sum(_wire_out_by_codec(res_raw, "RegisterBlock").values())
+    gossip_cod = sum(_wire_out_by_codec(res_cod, "RegisterBlock").values())
+    assert gossip_raw > 0 and gossip_cod > 0
+    assert gossip_raw / gossip_cod >= 2.0, (gossip_raw, gossip_cod)
+    # both runs trained: finite errors on the shared split
+    assert all(np.isfinite(r["final_error"]) for r in res_raw + res_cod)
+
+
+@pytest.mark.slow
+def test_acceptance_mnist_cnn_f32_zlib_3x_fewer_gossip_bytes():
+    """ISSUE 4 acceptance: a 4-node live cluster with f32+zlib gossip
+    shows >= 3x fewer gossip bytes/round than raw64 on the mnist_cnn
+    config, with share recovery and commitment verification passing and
+    final error matching within noise."""
+    _, res_raw = _cluster(27440, "mnist", ["raw64"] * 4,
+                          noising=False, model_name="mnist_cnn")
+    agents, res_cod = _cluster(27450, "mnist", ["f32+zlib"] * 4,
+                               noising=False, model_name="mnist_cnn")
+    for results in (res_raw, res_cod):
+        dumps = [r["chain_dump"] for r in results]
+        assert all(d == dumps[0] for d in dumps)
+    assert sum(a.counters.get("submission_rejected", 0)
+               for a in agents) == 0
+    assert sum(a.counters.get("secret_registered", 0) for a in agents) > 0
+    rounds_raw = max(r["iterations"] for r in res_raw)
+    rounds_cod = max(r["iterations"] for r in res_cod)
+    per_raw = sum(_wire_out_by_codec(res_raw, "RegisterBlock").values()) \
+        / max(1, rounds_raw)
+    per_cod = sum(_wire_out_by_codec(res_cod, "RegisterBlock").values()) \
+        / max(1, rounds_cod)
+    assert per_raw / per_cod >= 3.0, (per_raw, per_cod)
+    err_raw = np.median([r["final_error"] for r in res_raw])
+    err_cod = np.median([r["final_error"] for r in res_cod])
+    assert abs(err_raw - err_cod) <= 0.2, (err_raw, err_cod)
+
+
+# ------------------------------------------------------------ negotiation
+
+def test_negotiation_and_capabilities():
+    assert wcodecs.negotiate("f32+zlib", wcodecs.FULL_CAPS) == "f32+zlib"
+    assert wcodecs.negotiate("f32+zlib", wcodecs.RAW_CAPS) == "raw64"
+    assert wcodecs.negotiate("raw64", wcodecs.FULL_CAPS) == "raw64"
+    assert wcodecs.negotiate("garbage+zlib", wcodecs.FULL_CAPS) == "raw64"
+    assert wcodecs.capabilities("raw64") == wcodecs.RAW_CAPS
+    assert "chunk" in wcodecs.capabilities("zlib")
+    # canonical stage ordering regardless of spelling
+    assert wcodecs.canonical("zlib+f32") == "f32+zlib"
+    with pytest.raises(wcodecs.WireCodecError):
+        wcodecs.parse_codec("f32+bf16")
+
+
+def test_config_rejects_bad_codec():
+    from biscotti_tpu.config import BiscottiConfig
+
+    with pytest.raises(ValueError):
+        BiscottiConfig(wire_codec="f64+lzma")
+    cfg = BiscottiConfig(wire_codec="topk+f32+zlib")
+    assert cfg.wire_codec == "topk+f32+zlib"
