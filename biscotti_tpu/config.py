@@ -16,8 +16,10 @@ import enum
 import math
 from dataclasses import dataclass, field
 
-# stdlib-only module (hash-derived decisions, breaker state machine): safe
-# to import here without dragging the asyncio runtime into config users
+# stdlib-only modules (hash-derived decisions, breaker state machine,
+# token buckets): safe to import here without dragging the asyncio
+# runtime into config users
+from biscotti_tpu.runtime.admission import AdmissionPlan
 from biscotti_tpu.runtime.faults import FaultPlan
 
 
@@ -189,10 +191,18 @@ class BiscottiConfig:
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 5.0
     # seeded deterministic fault injection over the live RPC transport
-    # (drop/delay/duplicate/reset per frame); default = disabled. The
-    # simulator mirrors the `drop` knob at round granularity (parallel/
-    # sim.py) so degraded-round semantics agree between sim and live.
+    # (drop/delay/duplicate/reset/flood per frame); default = disabled.
+    # The simulator mirrors the `drop` knob at round granularity
+    # (parallel/sim.py) so degraded-round semantics agree between sim
+    # and live.
     fault_plan: FaultPlan = field(default_factory=FaultPlan)
+    # overload governance (runtime/admission.py, docs/ADMISSION.md):
+    # per-message-class token-bucket rates, per-peer/global inflight
+    # caps, bounded parked-waiter budget, slow-loris read deadline.
+    # Over-budget inbound work is shed with a retryable BusyError that
+    # never advances the circuit breaker. Default = disabled (seed
+    # behavior: admit everything, park without bound).
+    admission_plan: AdmissionPlan = field(default_factory=AdmissionPlan)
 
     # --- wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md) ---
     # negotiated payload codec for protocol traffic: "raw64" (legacy
@@ -274,6 +284,9 @@ class BiscottiConfig:
                 f"wire_topk={self.wire_topk} must be in (0, 1]")
         if self.wire_chunk_bytes < 0:
             raise ValueError("wire_chunk_bytes must be >= 0")
+        # an enabled admission plan with nonsensical caps must fail at
+        # construction, not mid-round when the first frame is budgeted
+        self.admission_plan.validate()
 
     # ------------------------------------------------------------------ derived
 
@@ -413,6 +426,44 @@ class BiscottiConfig:
                        help="P(outbound frame written twice)")
         p.add_argument("--fault-reset", type=float, default=FaultPlan.reset,
                        help="P(connection torn down instead of writing)")
+        p.add_argument("--fault-flood", type=int, default=FaultPlan.flood,
+                       help="frame-storm replay factor: every outbound "
+                            "frame is written 1+N times (deterministic "
+                            "flooding peer for admission tests)")
+        p.add_argument("--admission", type=int,
+                       default=int(AdmissionPlan.enabled),
+                       help="1 arms the overload-governance plane: "
+                            "over-budget inbound work is shed with a "
+                            "retryable busy status (docs/ADMISSION.md)")
+        p.add_argument("--admit-update-rate", type=float,
+                       default=AdmissionPlan.update_rate,
+                       help="token-bucket rate (frames/s per peer) for "
+                            "update-class messages")
+        p.add_argument("--admit-bulk-rate", type=float,
+                       default=AdmissionPlan.bulk_rate,
+                       help="token-bucket rate for bulk-class messages "
+                            "(block push/pull, chain adoption)")
+        p.add_argument("--admit-control-rate", type=float,
+                       default=AdmissionPlan.control_rate,
+                       help="token-bucket rate for control-class messages")
+        p.add_argument("--admit-burst-factor", type=float,
+                       default=AdmissionPlan.burst_factor,
+                       help="bucket capacity = rate x this factor")
+        p.add_argument("--admit-peer-inflight", type=int,
+                       default=AdmissionPlan.peer_inflight,
+                       help="max concurrent inbound handlers per peer")
+        p.add_argument("--admit-global-inflight", type=int,
+                       default=AdmissionPlan.global_inflight,
+                       help="max concurrent inbound handlers, all peers")
+        p.add_argument("--admit-parked", type=int,
+                       default=AdmissionPlan.max_parked,
+                       help="max handlers parked for a future round "
+                            "(the oldest waiter is shed at the cap)")
+        p.add_argument("--admit-read-deadline-s", type=float,
+                       default=AdmissionPlan.read_deadline_s,
+                       help="seconds one inbound frame may stay "
+                            "partially received before the connection "
+                            "drops (slow-loris bound)")
         p.add_argument("--wire-codec", type=str,
                        default=BiscottiConfig.wire_codec,
                        help="payload codec for protocol traffic "
@@ -497,6 +548,27 @@ class BiscottiConfig:
                 delay_s=getattr(ns, "fault_delay_s", FaultPlan.delay_s),
                 duplicate=getattr(ns, "fault_dup", FaultPlan.duplicate),
                 reset=getattr(ns, "fault_reset", FaultPlan.reset),
+                flood=getattr(ns, "fault_flood", FaultPlan.flood),
+            ),
+            admission_plan=AdmissionPlan(
+                enabled=bool(getattr(ns, "admission",
+                                     AdmissionPlan.enabled)),
+                update_rate=getattr(ns, "admit_update_rate",
+                                    AdmissionPlan.update_rate),
+                bulk_rate=getattr(ns, "admit_bulk_rate",
+                                  AdmissionPlan.bulk_rate),
+                control_rate=getattr(ns, "admit_control_rate",
+                                     AdmissionPlan.control_rate),
+                burst_factor=getattr(ns, "admit_burst_factor",
+                                     AdmissionPlan.burst_factor),
+                peer_inflight=getattr(ns, "admit_peer_inflight",
+                                      AdmissionPlan.peer_inflight),
+                global_inflight=getattr(ns, "admit_global_inflight",
+                                        AdmissionPlan.global_inflight),
+                max_parked=getattr(ns, "admit_parked",
+                                   AdmissionPlan.max_parked),
+                read_deadline_s=getattr(ns, "admit_read_deadline_s",
+                                        AdmissionPlan.read_deadline_s),
             ),
         )
 

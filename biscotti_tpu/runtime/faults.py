@@ -55,18 +55,23 @@ class CircuitOpenError(ConnectionError):
 @dataclass(frozen=True)
 class FaultAction:
     """One frame's fate. Precedence when several faults draw true:
-    reset > drop > (delay, duplicate) — a reset connection can deliver
-    nothing, a dropped frame cannot also arrive twice."""
+    reset > drop > (delay, duplicate, flood) — a reset connection can
+    deliver nothing, a dropped frame cannot also arrive twice."""
 
     drop: bool = False
     duplicate: bool = False
     reset: bool = False
     delay_s: float = 0.0
+    # frame-storm replay count (the flood fault kind, docs/ADMISSION.md):
+    # the frame is written 1 + flood times back-to-back, turning this
+    # peer into a deterministic flooder — the adversary the admission
+    # plane's shedding is tested against
+    flood: int = 0
 
     @property
     def benign(self) -> bool:
         return not (self.drop or self.duplicate or self.reset
-                    or self.delay_s > 0.0)
+                    or self.delay_s > 0.0 or self.flood > 0)
 
     def kind(self) -> str:
         """Compact label for tallies/logs."""
@@ -74,6 +79,8 @@ class FaultAction:
             return "reset"
         if self.drop:
             return "drop"
+        if self.flood > 0:
+            return "flood"
         if self.duplicate and self.delay_s > 0:
             return "delay+dup"
         if self.duplicate:
@@ -108,11 +115,18 @@ class FaultPlan:
     delay_s: float = 0.05   # max per-frame delay; actual in [½·delay_s, delay_s]
     duplicate: float = 0.0  # P(frame written twice back-to-back)
     reset: float = 0.0      # P(connection torn down instead of writing)
+    # flood replay factor: every outbound frame is written 1 + flood
+    # times, so an armed peer sustains (1 + flood)× the honest frame rate
+    # toward every target — the deterministic frame storm the admission
+    # plane's shedding is asserted against (docs/ADMISSION.md). Applied
+    # to every frame (no draw needed: replay count is the knob), except
+    # frames that reset or drop first.
+    flood: int = 0
 
     @property
     def enabled(self) -> bool:
         return (self.drop > 0.0 or self.delay > 0.0 or self.duplicate > 0.0
-                or self.reset > 0.0)
+                or self.reset > 0.0 or self.flood > 0)
 
     def action(self, src: int, dst: int, msg_type: str,
                attempt: int = 0, seq: int = 0) -> FaultAction:
@@ -134,9 +148,10 @@ class FaultPlan:
         d = 0.0
         if u[3] < self.delay:
             d = self.delay_s * (0.5 + 0.5 * u[4])
-        if not dup and d == 0.0:
+        if not dup and d == 0.0 and self.flood <= 0:
             return _BENIGN
-        return FaultAction(duplicate=dup, delay_s=d)
+        return FaultAction(duplicate=dup, delay_s=d,
+                           flood=max(0, int(self.flood)))
 
 
 class FaultInjector:

@@ -179,6 +179,32 @@ def encode(msg_type: str, meta: Dict[str, Any] | None = None,
                                  chunk_bytes=chunk_bytes, stats=stats))
 
 
+def peek_header(payload) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """Parse ONLY the frame's JSON header — no array materialization, no
+    zlib inflate. The admission boundary (rpc.RPCServer, docs/ADMISSION.md)
+    budgets every frame on (msg_type, meta) BEFORE paying its decode
+    cost; without this, a flooder's shed frames would still pin the
+    event loop with full-frame decompression. Returns None on any
+    malformation (callers drop the connection, exactly as decode's
+    CodecError path would). `meta["_wire_codec"]` is set from the header
+    the same authoritative way decode sets it."""
+    try:
+        if len(payload) < 4:
+            return None
+        (hlen,) = struct.unpack(">I", payload[:4])
+        if hlen > len(payload) - 4:
+            return None
+        header = json.loads(bytes(payload[4: 4 + hlen]).decode())
+        msg_type = header["type"]
+        meta = header.get("meta", {})
+        if not isinstance(msg_type, str) or not isinstance(meta, dict):
+            return None
+        meta["_wire_codec"] = header.get("codec", wcodecs.RAW)
+        return msg_type, meta
+    except Exception:
+        return None
+
+
 def decode(payload: bytes) -> Tuple[str, Dict[str, Any], Dict[str, np.ndarray]]:
     """Decode one frame payload (the bytes after the frame-length prefix,
     chunk runs already reassembled by rpc.FrameStream). Raises CodecError

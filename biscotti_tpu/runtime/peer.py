@@ -48,10 +48,11 @@ from biscotti_tpu.models.trainer import Trainer
 from biscotti_tpu.ops import secretshare as ss
 from biscotti_tpu.parallel import roles as R
 from biscotti_tpu.parallel.sim import _poisoned_ids
+from biscotti_tpu.runtime import admission as adm
 from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
 from biscotti_tpu.runtime.faults import CircuitOpenError
-from biscotti_tpu.runtime.rpc import RPCError, StaleError
+from biscotti_tpu.runtime.rpc import BusyError, RPCError, StaleError
 from biscotti_tpu.telemetry import Telemetry, serve_metrics
 from biscotti_tpu.tools import keygen
 
@@ -253,6 +254,18 @@ class PeerAgent:
             # pure function of (fault seed, src, dst, msg_type, attempt)
             self.pool.faults = faults.FaultInjector(
                 cfg.fault_plan, self.id, self._peer_for_addr)
+        # overload-governance plane (runtime/admission.py): ALWAYS
+        # constructed — the inflight/parked accounting and the snapshot
+        # schema exist either way — but only an ENABLED plan wires
+        # enforcement into the RPC server boundary and arms the
+        # slow-loris read deadline; a disabled plan admits everything
+        # and parks without bound (the seed behavior)
+        self.admission = adm.AdmissionController(cfg.admission_plan)
+        # peers that answered BusyError this round: retried with backoff
+        # (never breaker-fed) and DEPRIORITIZED by gossip fan-out until
+        # the round advances — an overloaded peer gets breathing room,
+        # not quarantine
+        self._busy_peers: Dict[int, int] = {}
         # with a peers file the PORT layout is the dealer's, not
         # base_port+id arithmetic; the bind ADDRESS stays cfg.my_ip — the
         # peers-file entry is how others reach us, which behind NAT is not
@@ -284,12 +297,22 @@ class PeerAgent:
         # in run()'s result; eval/eval_cost_breakdown.py aggregates them
         self.phases = self.tele.phases
         if cfg.telemetry:
-            # transport + fault-plane instrumentation share the registry
+            # transport + fault-plane + admission instrumentation share
+            # the registry
             self.pool.metrics = self.tele.registry
             self.server.metrics = self.tele.registry
             if self.pool.faults is not None:
                 self.pool.faults.metrics = self.tele.registry
+            self.admission.metrics = self.tele.registry
             self.trainer.metrics = self.tele.registry
+        # the controller is wired into the server UNCONDITIONALLY so the
+        # inflight accounting (and its gauges) is live even in
+        # observability-only runs; a DISABLED plan admits everything
+        # inside try_admit, so enforcement — and the read deadline —
+        # only engage when the plan is armed
+        self.server.admission = self.admission
+        if cfg.admission_plan.enabled:
+            self.server.read_deadline = cfg.admission_plan.read_deadline_s
         # reply-codec capability set for the RPC server: callers request
         # a reply codec via `acodec`, granted iff inside OUR caps
         self.server.caps = self.caps
@@ -347,6 +370,12 @@ class PeerAgent:
             "per-peer circuit breaker: 0 closed, 1 half-open, 2 open")
         for pid, h in self.health.snapshot().items():
             breaker.set(self._BREAKER_LEVEL.get(h["state"], 2), peer=pid)
+        # admission levels, pull-refreshed so a scrape is never stale
+        # (the controller also pushes on change)
+        reg.gauge(adm.INFLIGHT_GAUGE, adm.INFLIGHT_HELP).set(
+            self.admission.inflight_total)
+        reg.gauge(adm.PARKED_GAUGE, adm.PARKED_HELP).set(
+            len(self.admission.parking))
 
     def telemetry_snapshot(self) -> Dict:
         """THE public observability readout — one structured dict serving
@@ -366,6 +395,12 @@ class PeerAgent:
             "faults": (dict(self.pool.faults.counts)
                        if self.pool.faults is not None else {}),
             "metrics": self.tele.registry.snapshot(),
+            # overload-governance readout (runtime/admission.py): shed
+            # tallies by reason, current + peak inflight/parked levels,
+            # and the configured caps — the chaos report and the flood
+            # acceptance assertions (bounded peaks, nonzero sheds on
+            # honest peers) read THIS, not private controller state
+            "admission": self.admission.snapshot(),
             # the recorder may be real even with telemetry disabled (an
             # explicit spill path keeps the event log alive) — report
             # whatever it actually holds
@@ -491,6 +526,13 @@ class PeerAgent:
         else:
             self.peer_caps[pid] = wcodecs.RAW_CAPS
 
+    def _peer_busy(self, pid: int) -> bool:
+        """True while `pid` is deprioritized for gossip: it answered
+        BusyError during the CURRENT round. Round-scoped on purpose —
+        overload is transient, and a new round is fresh evidence either
+        way (a still-busy peer re-marks itself on the next busy reply)."""
+        return self._busy_peers.get(pid) == self.iteration
+
     def _record_peer_ok(self, peer_id: int) -> None:
         """One RPC toward `peer_id` proved the transport healthy: reset its
         failure streak and, if the breaker was tripped, close it."""
@@ -561,6 +603,21 @@ class PeerAgent:
                 self._record_peer_ok(peer_id)
                 self._schedule_catch_up(peer_id)
                 raise
+            except BusyError as e:
+                # overload signal, NOT a fault (docs/ADMISSION.md): the
+                # busy reply PROVES the transport and the peer healthy, so
+                # the breaker must not advance — a busy honest peer must
+                # never be quarantined. Retry with the same backoff the
+                # transport plane uses, and deprioritize the peer for this
+                # round's gossip fan-out so it gets breathing room.
+                self._record_peer_ok(peer_id)
+                self._busy_peers[peer_id] = self.iteration
+                last = e
+                if attempt + 1 >= attempts:
+                    break
+                self._trace("rpc_busy_retry", peer=peer_id, msg=msg_type,
+                            attempt=attempt + 1)
+                await asyncio.sleep(next(backoff))
             except RPCError:
                 self._record_peer_ok(peer_id)
                 raise
@@ -581,8 +638,13 @@ class PeerAgent:
                 if i_am_probe:
                     self.health.release_probe(peer_id)
                 raise
-        self.alive.discard(peer_id)
         assert last is not None
+        if isinstance(last, BusyError):
+            # budget exhausted against a BUSY peer: it is alive and
+            # healthy — do not evict it from the gossip liveness set
+            self._trace("rpc_busy_give_up", peer=peer_id, msg=msg_type)
+            raise last
+        self.alive.discard(peer_id)
         raise last
 
     def _schedule_catch_up(self, pid: int) -> None:
@@ -703,32 +765,58 @@ class PeerAgent:
         run's absolute end are refused IMMEDIATELY — parking them would
         let one hostile packet pin a handler task for the full budget.
         Anything inside [0, max_iterations] stays parkable: a peer far
-        behind can legitimately leap there via one chain adoption."""
+        behind can legitimately leap there via one chain adoption.
+
+        Parking is a COUNTED, CAPPED resource (runtime/admission.py):
+        with an enabled admission plan, the lot sheds its OLDEST waiter
+        (woken into a retryable BusyError) instead of growing without
+        bound — the pre-admission behavior let one hostile peer park
+        thousands of 30-second handler tasks for free."""
         if it > self.cfg.max_iterations:
             raise RPCError("iteration beyond reachable horizon")
-        deadline = time.monotonic() + budget
-        while self.iteration < it:
-            if time.monotonic() > deadline:
-                raise RPCError("caller too far ahead")
-            await asyncio.sleep(0.05)
+        if self.iteration >= it:
+            return  # no wait, no parking accounting
+        tok = self.admission.park("wait_iteration")
+        try:
+            deadline = time.monotonic() + budget
+            while self.iteration < it:
+                if tok.shed is not None:
+                    raise BusyError("parked waiter shed: " + tok.shed)
+                if time.monotonic() > deadline:
+                    raise RPCError("caller too far ahead")
+                await asyncio.sleep(0.05)
+        finally:
+            self.admission.unpark(tok)
 
     async def _wait_round_ready(self, it: int, budget: float = 30.0) -> RoundState:
         """Park until OUR round state for iteration `it` exists — callers may
         race ahead of a peer that is still bootstrapping or mid-transition
         (the reference blocks such callers the same way, krum.go:240-243).
         Returns the ready RoundState; raises StaleError if we are already
-        past `it`."""
+        past `it`. Parked time is budgeted by the admission plane's
+        parking lot, same as _wait_for_iteration."""
         await self._wait_for_iteration(it, budget)
-        deadline = time.monotonic() + budget
-        while True:
-            if self.iteration > it:
-                raise StaleError()
-            st = self.round
-            if st.iteration == it and st.krum_decision is not None:
-                return st
-            if time.monotonic() > deadline:
-                raise RPCError("round never became ready")
-            await asyncio.sleep(0.02)
+        if self.iteration > it:
+            raise StaleError()
+        st = self.round
+        if st.iteration == it and st.krum_decision is not None:
+            return st  # fast path: round already live, no parking
+        tok = self.admission.park("wait_round_ready")
+        try:
+            deadline = time.monotonic() + budget
+            while True:
+                if self.iteration > it:
+                    raise StaleError()
+                st = self.round
+                if st.iteration == it and st.krum_decision is not None:
+                    return st
+                if tok.shed is not None:
+                    raise BusyError("parked waiter shed: " + tok.shed)
+                if time.monotonic() > deadline:
+                    raise RPCError("round never became ready")
+                await asyncio.sleep(0.02)
+        finally:
+            self.admission.unpark(tok)
 
     async def _h_register_peer(self, meta, arrays):
         """Join/announce: record the caller, return our chain so they can
@@ -868,13 +956,23 @@ class PeerAgent:
         # fan-out skips it until a half-open probe — or its own inbound
         # rejoin traffic — re-admits it.
         targets = []
+        busy_targets = []
         for pid in self.peers:
             if pid == self.id:
                 continue
             if not self.health.available(pid):
                 self._trace("gossip_skip_quarantined", peer=pid)
                 continue
-            targets.append(pid)
+            # a peer that answered BusyError THIS round is deprioritized,
+            # not dropped: full pushes still deliver (last, so fresh peers
+            # drain first), but the advertise fan-out samples it only when
+            # fresh targets cannot fill the draw — epidemic coverage still
+            # reaches it through other peers' re-gossip
+            if self._peer_busy(pid):
+                self._trace("gossip_deprioritize_busy", peer=pid)
+                busy_targets.append(pid)
+            else:
+                targets.append(pid)
         if full:
             from biscotti_tpu.runtime import messages as msgs
 
@@ -887,6 +985,10 @@ class PeerAgent:
             # (frame bytes, effective codec) per group — the effective
             # codec (from encode stats) labels the byte accounting, so
             # a block whose arrays all fell back to raw counts as raw64
+            # fresh targets first, busy ones last: every peer still gets
+            # the block (it is a push they need to advance), but a peer
+            # shedding load is not first in line for a multi-MB frame
+            targets = targets + busy_targets
             frames: Dict[Tuple[str, int], Tuple[bytes, str]] = {}
             group: Dict[int, Tuple[str, int]] = {}
             for pid in targets:
@@ -939,9 +1041,15 @@ class PeerAgent:
 
         import math
 
-        fanout = max(3, int(math.log2(max(2, len(targets)))) + 1)
+        population = len(targets) + len(busy_targets)
+        fanout = max(3, int(math.log2(max(2, population))) + 1)
         if len(targets) > fanout:
             targets = self._rng.sample(targets, fanout)
+        elif len(targets) < fanout and busy_targets:
+            # fresh targets cannot fill the draw: top up from the busy
+            # set rather than shrinking coverage below the epidemic bound
+            need = min(fanout - len(targets), len(busy_targets))
+            targets = targets + self._rng.sample(busy_targets, need)
         ad = {"iteration": blk.iteration, "hash": blk.hash.hex(),
               "source_id": self.id}
 

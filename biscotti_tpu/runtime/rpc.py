@@ -67,6 +67,19 @@ class StaleError(RPCError):
         super().__init__(reason, stale=True)
 
 
+class BusyError(RPCError):
+    """Retryable overload signal (wire meta: {"error": ..., "busy": true}):
+    the callee SHED this request under its admission plan rather than
+    queue it without bound (runtime/admission.py, docs/ADMISSION.md).
+    Unlike a transport failure it proves the peer alive and healthy —
+    clients retry with backoff and must NOT advance the circuit breaker
+    (a busy honest peer must never get quarantined)."""
+
+    def __init__(self, reason: str = "server busy"):
+        super().__init__(reason)
+        self.busy = True
+
+
 class FrameStream(asyncio.BufferedProtocol):
     """Framed connection over asyncio's zero-copy receive path.
 
@@ -97,7 +110,7 @@ class FrameStream(asyncio.BufferedProtocol):
     _QUEUE_HIGH = 8
     _CLOSED = object()  # queue sentinel
 
-    def __init__(self, on_connected=None):
+    def __init__(self, on_connected=None, read_deadline: float = 0.0):
         self.transport: Optional[asyncio.Transport] = None
         self._on_connected = on_connected
         self._acc = bytearray()
@@ -112,13 +125,67 @@ class FrameStream(asyncio.BufferedProtocol):
         self._read_paused = False
         self._w_waiters: list = []
         self._w_paused = False
+        # read/header deadline (admission plane, docs/ADMISSION.md):
+        # once a frame STARTS — a header byte, a partial payload, an
+        # unfinished chunk-reassembly run — it must COMPLETE within this
+        # many seconds or the connection is dropped. Progress-per-byte
+        # deliberately does NOT reset the clock (a slow-loris dribbling
+        # one header byte per tick would otherwise pin the connection
+        # and its reassembly buffer forever), but each COMPLETED frame —
+        # including every continuation chunk of a reassembly run — does:
+        # a legitimate chunked multi-MB transfer only needs one chunk
+        # per window, while a dribbler must pay a full frame per window.
+        # Time spent with reading PAUSED by our own backpressure also
+        # counts as progress — the peer must not be blamed for our
+        # queue. 0 disables (client default).
+        self._read_deadline = float(read_deadline)
+        self._frame_t0: Optional[float] = None
+        self._deadline_handle = None
+        self._progress_seq = 0  # bumped per completed frame/chunk
 
     # ------------------------------------------------ protocol callbacks
 
     def connection_made(self, transport) -> None:
         self.transport = transport
+        if self._read_deadline > 0:
+            loop = asyncio.get_running_loop()
+            self._deadline_handle = loop.call_later(
+                self._read_deadline / 2, self._deadline_tick)
         if self._on_connected is not None:
             asyncio.get_running_loop().create_task(self._on_connected(self))
+
+    def _mid_frame(self) -> bool:
+        return (self._payload is not None or len(self._acc) > 0
+                or self._reasm is not None)
+
+    def _mark_frame_progress(self, completed: bool) -> None:
+        """Called after every receive/parse step: start the per-frame
+        deadline clock when partial state appears, restart it whenever a
+        frame or continuation chunk COMPLETED, clear it when the stream
+        is back at a frame boundary."""
+        if self._read_deadline <= 0:
+            return
+        if not self._mid_frame():
+            self._frame_t0 = None
+        elif completed or self._frame_t0 is None:
+            self._frame_t0 = asyncio.get_running_loop().time()
+
+    def _deadline_tick(self) -> None:
+        if self._closed or self.transport is None:
+            return
+        loop = asyncio.get_running_loop()
+        if self._read_paused and self._frame_t0 is not None:
+            # WE paused reading (queue backpressure): the peer cannot
+            # make progress — don't bill it for our slowness
+            self._frame_t0 = loop.time()
+        if (self._frame_t0 is not None
+                and loop.time() - self._frame_t0 >= self._read_deadline):
+            self._protocol_error(ConnectionError(
+                "read deadline: frame incomplete after "
+                f"{self._read_deadline:.1f}s"))
+            return
+        self._deadline_handle = loop.call_later(
+            self._read_deadline / 2, self._deadline_tick)
 
     def get_buffer(self, sizehint: int) -> memoryview:
         if self._payload is not None:
@@ -126,6 +193,7 @@ class FrameStream(asyncio.BufferedProtocol):
         return memoryview(self._scratch)
 
     def buffer_updated(self, nbytes: int) -> None:
+        seq0 = self._progress_seq
         if self._payload is not None:
             self._got += nbytes
             if self._got >= self._need:
@@ -133,9 +201,11 @@ class FrameStream(asyncio.BufferedProtocol):
                 self._payload = None
                 self._got = self._need = 0
                 self._enqueue(payload)
+            self._mark_frame_progress(self._progress_seq != seq0)
             return
         self._acc += memoryview(self._scratch)[:nbytes]
         self._drain_acc()
+        self._mark_frame_progress(self._progress_seq != seq0)
 
     def _drain_acc(self) -> None:
         while True:
@@ -161,6 +231,7 @@ class FrameStream(asyncio.BufferedProtocol):
             return
 
     def _enqueue(self, frame) -> None:
+        self._progress_seq += 1  # a complete frame payload (or chunk)
         if (len(frame) >= msgs.CHUNK_OVERHEAD
                 and bytes(memoryview(frame)[:4]) == msgs.CHUNK_MAGIC):
             # continuation chunk: accumulate; only the final chunk of the
@@ -195,6 +266,9 @@ class FrameStream(asyncio.BufferedProtocol):
 
     def connection_lost(self, exc) -> None:
         self._closed = True
+        if self._deadline_handle is not None:
+            self._deadline_handle.cancel()
+            self._deadline_handle = None
         if self._exc is None:
             self._exc = exc or ConnectionError("connection closed")
         self._frames.put_nowait(self._CLOSED)
@@ -275,11 +349,19 @@ class RPCServer:
         # seed); `metrics` ticks inbound/outbound byte counters
         self.caps = wcodecs.RAW_CAPS
         self.metrics = None
+        # overload-governance knobs (runtime/admission.py), set by the
+        # owning peer when its AdmissionPlan is enabled: `admission` is
+        # the AdmissionController consulted per decoded frame (None =
+        # admit everything, the seed behavior); `read_deadline` arms
+        # FrameStream's mid-frame deadline on inbound connections
+        self.admission = None
+        self.read_deadline = 0.0
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
         self._server = await loop.create_server(
-            lambda: FrameStream(on_connected=self._on_conn),
+            lambda: FrameStream(on_connected=self._on_conn,
+                                read_deadline=self.read_deadline),
             self.host, self.port)
 
     async def stop(self) -> None:
@@ -297,6 +379,48 @@ class RPCServer:
             except asyncio.TimeoutError:
                 pass
 
+    @staticmethod
+    def _admit_key(stream: FrameStream):
+        """Budget key for one inbound frame: the CONNECTION identity
+        (transport peername), never the frame's claimed `source_id` —
+        meta is unauthenticated, so keying on the claimed id would let a
+        Byzantine peer spoof a victim's id and drain the victim's
+        buckets, starving its legitimate traffic. The peername is
+        TCP-level and unspoofable; honest peers multiplex everything
+        over ONE pooled connection, so per-connection IS per-peer for
+        them, while a Byzantine peer fanning out connections is bounded
+        by the controller's bucket-table cap and the global inflight
+        cap."""
+        peername = (stream.transport.get_extra_info("peername")
+                    if stream.transport is not None else None)
+        return ("conn", peername if peername is not None else id(stream))
+
+    def _shed_reply(self, msg_type, meta, reason, stream):
+        """Busy reply for a shed reply-bearing call — small, encoded
+        inline, and NOT drained: a flooder that refuses to read its own
+        busy replies must not be able to park the read loop on its
+        socket's backpressure. Once the transport signals pause_writing
+        (the peer stopped draining), further notifications are DROPPED
+        instead of buffered — otherwise the reply path itself would be
+        the unbounded-memory vector this plane exists to close; the
+        peer's calls simply time out, which under overload is truthful.
+        Safe without the write lock: write_parts is synchronous, so
+        frames never interleave — the lock only orders write+drain
+        pairs for handler replies."""
+        rid = meta.get("rid")
+        if not rid:
+            return  # fire-and-forget: nobody is waiting for a reply
+        if stream._w_paused or not stream.alive:
+            return  # peer not draining: drop the notification
+        parts = msgs.encode_parts(
+            msg_type + ".reply",
+            {"error": f"admission shed: {reason}", "busy": True,
+             "rid": rid}, {})
+        try:
+            stream.write_parts(parts)
+        except (ConnectionError, OSError):
+            pass
+
     async def _on_conn(self, stream: FrameStream) -> None:
         task = asyncio.current_task()
         self._conn_tasks.add(task)
@@ -308,19 +432,47 @@ class RPCServer:
                     payload = await stream.next_frame()
                 except (ConnectionError, OSError):
                     break
+                key = None
+                if self.admission is not None:
+                    # overload governance (docs/ADMISSION.md): the frame
+                    # is budgeted on its PEEKED header alone — over-budget
+                    # work is SHED with a retryable busy status BEFORE
+                    # paying the full decode (array materialization, zlib
+                    # inflate), so a flood's per-frame cost to this peer
+                    # is one small JSON parse, not a decompression
+                    peek = msgs.peek_header(payload)
+                    if peek is None:
+                        break  # malformed header: drop the connection
+                    msg_type, pmeta = peek
+                    if self.metrics is not None:
+                        self.metrics.counter(
+                            wcodecs.WIRE_BYTES_METRIC,
+                            wcodecs.WIRE_BYTES_HELP).inc(
+                            len(payload), msg_type=msg_type,
+                            direction="in",
+                            codec=pmeta.get("_wire_codec", wcodecs.RAW))
+                    key = self._admit_key(stream)
+                    reason = self.admission.try_admit(key, msg_type)
+                    if reason is not None:
+                        self._shed_reply(msg_type, pmeta, reason, stream)
+                        continue
                 try:
                     msg_type, meta, arrays = msgs.decode(payload)
                 except msgs.CodecError:
+                    if key is not None:
+                        self.admission.release(key)
                     break  # hostile/garbled peer: drop the connection
-                if self.metrics is not None:
+                if self.admission is None and self.metrics is not None:
                     self.metrics.counter(
                         wcodecs.WIRE_BYTES_METRIC,
                         wcodecs.WIRE_BYTES_HELP).inc(
                         len(payload), msg_type=msg_type, direction="in",
                         codec=meta.get("_wire_codec", wcodecs.RAW))
-                t = asyncio.create_task(
-                    self._dispatch(msg_type, meta, arrays, stream, write_lock)
-                )
+                t = asyncio.create_task(self._dispatch(
+                    msg_type, meta, arrays, stream, write_lock))
+                if key is not None:
+                    t.add_done_callback(
+                        lambda _t, k=key: self.admission.release(k))
                 pending.add(t)
                 t.add_done_callback(pending.discard)
         finally:
@@ -335,6 +487,11 @@ class RPCServer:
             rmeta, rarrays = await self.handler(msg_type, meta, arrays)
         except StaleError as e:
             rmeta, rarrays = {"error": e.reason, "stale": True}, {}
+        except BusyError as e:
+            # a handler shed mid-flight (e.g. its parked wait was evicted
+            # by the parking cap): same retryable wire status as a
+            # boundary shed
+            rmeta, rarrays = {"error": e.reason, "busy": True}, {}
         except RPCError as e:
             rmeta, rarrays = {"error": e.reason}, {}
         except asyncio.CancelledError:
@@ -466,6 +623,19 @@ class _Conn:
                         asyncio.get_running_loop().time() - t0))
                     self.stream.write_parts(parts)
                     await asyncio.wait_for(self.stream.drain(), left)
+                if fault is not None and fault.flood > 0:
+                    # frame storm: replay the same bytes `flood` more
+                    # times back-to-back — this peer becomes a seeded
+                    # flooder sustaining (1+flood)x the honest frame rate
+                    # on this link. The storm shares the ORIGINAL frame's
+                    # timeout budget; replays that outrun it (a receiver
+                    # exerting backpressure) are abandoned, exactly like
+                    # a real flooder hitting a full socket.
+                    for _ in range(fault.flood):
+                        left = max(0.001, timeout - (
+                            asyncio.get_running_loop().time() - t0))
+                        self.stream.write_parts(parts)
+                        await asyncio.wait_for(self.stream.drain(), left)
         except (asyncio.TimeoutError, ConnectionError, OSError):
             self.close()
             raise
@@ -670,6 +840,8 @@ class Pool:
         if rmeta.get("error"):
             if rmeta.get("stale"):
                 raise StaleError(rmeta["error"])
+            if rmeta.get("busy"):
+                raise BusyError(rmeta["error"])
             raise RPCError(rmeta["error"])
         return rmeta, rarrays
 
@@ -754,5 +926,7 @@ async def call(host: str, port: int, msg_type: str,
     if rmeta.get("error"):
         if rmeta.get("stale"):
             raise StaleError(rmeta["error"])
+        if rmeta.get("busy"):
+            raise BusyError(rmeta["error"])
         raise RPCError(rmeta["error"])
     return rmeta, rarrays

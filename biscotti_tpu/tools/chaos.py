@@ -9,11 +9,20 @@ replayed by re-running with the same flags (docs/FAULT_PLANE.md).
     python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 3 \
         --fault-seed 11 --fault-drop 0.10 --fault-delay 0.25 --fault-delay-s 0.05
 
+Flood scenario (docs/ADMISSION.md): one seeded flooding peer replays every
+outbound frame N extra times while every peer enforces the admission plan —
+the report then carries the cluster's shed tallies and inflight/parked
+peaks, so the ISSUE-5 acceptance run is replayable from the CLI:
+
+    python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 3 \
+        --flood 50 --flood-node 1 --admission 1
+
 Exit code 0 iff all peers finished with an equal settled chain prefix and
 at least one real (non-empty) block survived. The JSON report carries the
-per-peer fault tallies, retry/breaker counters, and health snapshots —
-the same accounting the pytest chaos suite asserts on
-(`pytest -m chaos` runs the checked-in matrix).
+per-peer fault tallies, retry/breaker counters, health snapshots, and
+(when admission/flood is armed) the shed accounting — the same readouts
+the pytest chaos suite asserts on (`pytest -m chaos` runs the checked-in
+matrix; `pytest -m flood` the flood scenarios).
 """
 
 from __future__ import annotations
@@ -85,22 +94,55 @@ def main(argv=None) -> int:
                     help="wire codec for the whole cluster (e.g. "
                          "f32+zlib) so chaos schedules also exercise "
                          "compressed/chunked frames")
+    ap.add_argument("--flood", type=int, default=0,
+                    help="arm ONE peer (--flood-node) as a seeded "
+                         "flooder: every frame it sends is replayed this "
+                         "many extra times (e.g. 50 = 51x the honest "
+                         "frame rate)")
+    ap.add_argument("--flood-node", type=int, default=1,
+                    help="which peer floods (miners are stake-elected "
+                         "per round, so in some rounds the flooder may "
+                         "itself be the minter — its shed block pushes "
+                         "then heal via advertise/pull, see "
+                         "docs/ADMISSION.md)")
+    ap.add_argument("--admission", type=int, default=-1,
+                    help="1 arms the overload-governance plane on every "
+                         "peer; 0 disables; default: armed iff --flood")
     ns = ap.parse_args(argv)
+    if ns.flood and not (0 <= ns.flood_node < ns.nodes):
+        ap.error(f"--flood-node {ns.flood_node} outside 0..{ns.nodes - 1}")
 
     import jax
 
     jax.config.update("jax_enable_x64", True)
 
+    from biscotti_tpu.runtime.admission import AdmissionPlan
     from biscotti_tpu.runtime.faults import FaultPlan
     from biscotti_tpu.runtime.peer import PeerAgent
 
     plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
                      delay=ns.fault_delay, delay_s=ns.fault_delay_s,
                      duplicate=ns.fault_dup, reset=ns.fault_reset)
+    # the flooder rides the SAME seeded plan plus the replay factor, so
+    # a mixed run (drop + flood) stays replayable from one seed
+    flood_plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
+                           delay=ns.fault_delay, delay_s=ns.fault_delay_s,
+                           duplicate=ns.fault_dup, reset=ns.fault_reset,
+                           flood=ns.flood)
+    admit = bool(ns.flood) if ns.admission < 0 else bool(ns.admission)
+    # harness-scaled budgets: a 4-node fast-timeout loopback cluster's
+    # honest rate is well under 1 frame/s/peer/class, so these rates are
+    # still ~10x headroom for honest traffic — while a 50x flood burst
+    # overruns the bucket and sheds. (The production defaults are sized
+    # for N=100 gossip fan-in and would let a 50x replay of THIS tiny
+    # cluster's traffic ride the burst unshed.)
+    admission = AdmissionPlan(enabled=admit, update_rate=8.0,
+                              bulk_rate=6.0, control_rate=16.0)
     fast = Timeouts(update_s=4.0, block_s=12.0, krum_s=3.0, share_s=4.0,
                     rpc_s=4.0)
 
     def cfg(i):
+        flooding = ns.flood > 0 and i == ns.flood_node
         return BiscottiConfig(
             node_id=i, num_nodes=ns.nodes, dataset=ns.dataset,
             base_port=ns.base_port, num_verifiers=1, num_miners=1,
@@ -110,7 +152,9 @@ def main(argv=None) -> int:
             sample_percent=1.0, batch_size=8, timeouts=fast,
             rpc_retries=ns.rpc_retries,
             breaker_threshold=ns.breaker_threshold,
-            breaker_cooldown_s=ns.breaker_cooldown_s, fault_plan=plan,
+            breaker_cooldown_s=ns.breaker_cooldown_s,
+            fault_plan=flood_plan if flooding else plan,
+            admission_plan=admission,
             wire_codec=ns.codec)
 
     async def go():
@@ -131,15 +175,22 @@ def main(argv=None) -> int:
         "fault_plan": {"seed": plan.seed, "drop": plan.drop,
                        "delay": plan.delay, "delay_s": plan.delay_s,
                        "duplicate": plan.duplicate, "reset": plan.reset},
+        "flood": {"factor": ns.flood, "node": ns.flood_node}
+                 if ns.flood else None,
+        "admission_enabled": admit,
         "settled_prefix_equal": prefix_equal,
         "settled_height": common,
         "real_blocks": real_blocks,
         "faults_injected": faults_fired,
         "rpc_retries": cluster["counters"].get("rpc_retry", 0),
         "breaker_opens": cluster["counters"].get("breaker_open", 0),
+        # shed tallies + inflight/parked peaks (merged in obs.py — one
+        # definition for this report and a live scrape)
+        "sheds": cluster["admission"],
         "cluster": cluster,
         "per_node": [{"node": s["node"], "iterations": s["iter"],
-                      "faults": s["faults"], "health": s["health"]}
+                      "faults": s["faults"], "health": s["health"],
+                      "admission": s.get("admission", {})}
                      for s in (r["telemetry"] for r in results)],
     }
     print(json.dumps(report, indent=2))

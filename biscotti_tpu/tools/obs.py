@@ -102,6 +102,36 @@ def merge_wire(snaps: List[Dict]) -> Dict:
     return out
 
 
+def merge_admission(snaps: List[Dict]) -> Dict:
+    """Merge every peer's admission readout (overload-governance plane,
+    docs/ADMISSION.md) into one cluster table: shed totals by reason and
+    by message type, plus the worst inflight/parked peaks seen — peaks
+    take `max` across peers (each peer's cap bounds its OWN runtime),
+    sheds sum. `shed_by_msg_type` comes off the `biscotti_shed_total`
+    metric labels; the structured `admission` snapshot carries reasons."""
+    out: Dict = {"enabled_peers": 0, "shed_total": 0, "shed_by_reason": {},
+                 "shed_by_msg_type": {}, "inflight_peak": 0,
+                 "parked_peak": 0}
+    for snap in snaps:
+        a = snap.get("admission") or {}
+        if a.get("enabled"):
+            out["enabled_peers"] += 1
+        out["shed_total"] += int(a.get("shed_total", 0))
+        for k, v in (a.get("shed") or {}).items():
+            out["shed_by_reason"][k] = \
+                out["shed_by_reason"].get(k, 0) + int(v)
+        out["inflight_peak"] = max(out["inflight_peak"],
+                                   int(a.get("inflight_peak", 0)))
+        out["parked_peak"] = max(out["parked_peak"],
+                                 int(a.get("parked_peak", 0)))
+        fam = (snap.get("metrics") or {}).get("biscotti_shed_total")
+        for row in (fam or {}).get("series", []):
+            mt = row.get("labels", {}).get("msg_type", "?")
+            out["shed_by_msg_type"][mt] = \
+                out["shed_by_msg_type"].get(mt, 0) + int(row.get("value", 0))
+    return out
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KB", "MB", "GB"):
         if abs(n) < 1024.0 or unit == "GB":
@@ -151,6 +181,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "faults": faults,
         "counters": counters,
         "wire": wire,
+        "admission": merge_admission(snaps),
         "phases": merge_phase_histograms(snaps),
         "per_node": per_node,
     }
@@ -187,6 +218,15 @@ def format_table(merged: Dict) -> str:
                       f"in {_fmt_bytes(wire['in_bytes'])}  "
                       f"({_fmt_bytes(wire.get('bytes_per_round', 0))}/round)"
                       + (f"   [{by_codec}]" if by_codec else "")]
+    adm = merged.get("admission") or {}
+    if adm.get("enabled_peers") or adm.get("shed_total"):
+        by_reason = ", ".join(f"{k}:{v}" for k, v in
+                              sorted(adm["shed_by_reason"].items()))
+        lines += ["", f"admission: shed {adm['shed_total']}"
+                      + (f" ({by_reason})" if by_reason else "")
+                      + f"   inflight peak {adm['inflight_peak']}"
+                      f"   parked peak {adm['parked_peak']}"
+                      f"   [{adm['enabled_peers']} peers enforcing]"]
     if merged["faults"]:
         lines += ["", "injected faults (cluster): " + ", ".join(
             f"{k}={v}" for k, v in sorted(merged["faults"].items()))]

@@ -184,6 +184,18 @@ def main(argv=None) -> int:
         "data_note": ("REAL data (sklearn-bundled corpus)"
                       if spec.real
                       else "synthetic shards (zero-egress env)"),
+        # each cell builds ONE Simulator (seed=seeds[0]) and varies only
+        # the run_scan seed argument, so "seeds" vary the protocol RNG
+        # (contributor sampling, DP noise, committee draws) over FIXED
+        # shard data and poisoner assignment — the reported mean±std is
+        # protocol-RNG variation, NOT full cross-seed (re-sharded)
+        # variation, and the gate margin inherits that partial
+        # correlation (ADVICE r5 #3)
+        "seeds_note": (
+            "seeds vary protocol RNG only (sampling/noise/committee "
+            "draws); shard data and poisoner assignment are fixed at "
+            f"seed={seeds[0]} across all replicates — mean±std "
+            "understates full cross-seed variation"),
     }
     het_alpha = dirichlet_alpha(args.dataset)
     if het_alpha is not None:

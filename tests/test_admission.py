@@ -1,0 +1,684 @@
+"""Overload-governance (admission-plane) tests — docs/ADMISSION.md.
+
+Unit level: token-bucket refill math on a fake clock, admission caps
+(per-peer / global inflight, per-class rates), parking-lot shed-oldest
+semantics, the flood fault kind's deterministic schedule, and the CLI
+surface.
+
+Client-path level (ISSUE-5 satellite): `PeerAgent._call` classifies
+BusyError as retry-with-backoff that never advances the HealthLedger
+breaker, a permanently-busy peer is given up on WITHOUT being evicted or
+quarantined, and gossip fan-out deprioritizes busy peers for the round.
+
+Transport level: the RPC server sheds over-cap work with a retryable
+busy wire status, and FrameStream's read deadline drops a slow-loris
+connection that dribbles a frame without ever completing it.
+
+Integration: a 4-node live-TCP cluster with one seeded flooding peer
+(`flood` fault kind at 50x the honest frame rate) completes training
+with the settled-chain oracle passing, nonzero sheds on honest peers,
+and inflight/parked peaks bounded by the configured caps. The heavier
+mnist acceptance run is `slow`+`flood` (`pytest -m flood`).
+"""
+
+import asyncio
+import struct
+
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.runtime import faults, rpc
+from biscotti_tpu.runtime.admission import (
+    AdmissionController, AdmissionPlan, TokenBucket, msg_class,
+)
+from biscotti_tpu.runtime.faults import FaultPlan
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.runtime.rpc import BusyError
+from biscotti_tpu.tools import chaos
+
+FAST = Timeouts(update_s=4.0, block_s=12.0, krum_s=3.0, share_s=4.0,
+                rpc_s=4.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=3, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+# A plan scaled to the tiny fast-timeout test clusters (see
+# tools/chaos.py): honest traffic stays ~10x under these rates while a
+# 50x flood burst overruns the bucket and sheds.
+TIGHT = AdmissionPlan(enabled=True, update_rate=8.0, bulk_rate=6.0,
+                      control_rate=16.0)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 50.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------ unit: bucket
+
+
+def test_token_bucket_refill_and_burst():
+    clk = FakeClock()
+    b = TokenBucket(rate=10.0, burst=5.0, clock=clk)
+    assert all(b.try_take() for _ in range(5)), "burst capacity is 5"
+    assert not b.try_take(), "bucket drained"
+    clk.t += 0.25  # 2.5 tokens refill
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()
+    clk.t += 100.0  # refill clamps at burst, never beyond
+    assert sum(b.try_take() for _ in range(10)) == 5
+
+
+def test_msg_classes_cover_the_rpc_surface():
+    assert msg_class("RegisterBlock") == "bulk"
+    assert msg_class("RegisterUpdate") == "update"
+    assert msg_class("Metrics") == "control"
+    # unknown methods get the conservative bulk budget
+    assert msg_class("TotallyMadeUp") == "bulk"
+
+
+def test_admission_plan_validation_and_cli():
+    with pytest.raises(ValueError):
+        BiscottiConfig(admission_plan=AdmissionPlan(enabled=True,
+                                                    update_rate=0.0))
+    with pytest.raises(ValueError):
+        AdmissionPlan(enabled=True, max_parked=0).validate()
+    AdmissionPlan(update_rate=0.0).validate()  # disabled: anything goes
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    BiscottiConfig.add_args(ap)
+    ns = ap.parse_args(["--admission", "1", "--admit-update-rate", "9",
+                        "--admit-parked", "7", "--fault-flood", "50"])
+    cfg = BiscottiConfig.from_args(ns)
+    assert cfg.admission_plan.enabled
+    assert cfg.admission_plan.update_rate == 9.0
+    assert cfg.admission_plan.max_parked == 7
+    assert cfg.fault_plan.flood == 50 and cfg.fault_plan.enabled
+
+
+# -------------------------------------------------------- unit: controller
+
+
+def test_controller_rate_shed_and_tallies():
+    clk = FakeClock()
+    plan = AdmissionPlan(enabled=True, update_rate=2.0, burst_factor=1.0)
+    ctrl = AdmissionController(plan, clock=clk)
+    assert ctrl.try_admit(("peer", 1), "RegisterUpdate") is None
+    assert ctrl.try_admit(("peer", 1), "RegisterUpdate") is None
+    assert ctrl.try_admit(("peer", 1), "RegisterUpdate") == "rate"
+    # a DIFFERENT peer has its own bucket
+    assert ctrl.try_admit(("peer", 2), "RegisterUpdate") is None
+    # and a different CLASS from the same peer too
+    assert ctrl.try_admit(("peer", 1), "Metrics") is None
+    clk.t += 1.0  # 2 tokens refill
+    assert ctrl.try_admit(("peer", 1), "RegisterUpdate") is None
+    snap = ctrl.snapshot()
+    assert snap["shed"] == {"rate": 1} and snap["shed_total"] == 1
+    assert snap["inflight"] == 5 and snap["inflight_peak"] == 5
+    for key in (("peer", 1),) * 3 + (("peer", 2),):
+        ctrl.release(key)
+    ctrl.release(("peer", 1))
+    assert ctrl.snapshot()["inflight"] == 0
+    assert ctrl.snapshot()["inflight_peak"] == 5
+
+
+def test_controller_inflight_caps():
+    plan = AdmissionPlan(enabled=True, peer_inflight=2, global_inflight=3,
+                         update_rate=1e9, bulk_rate=1e9, control_rate=1e9)
+    ctrl = AdmissionController(plan, clock=FakeClock())
+    assert ctrl.try_admit("a", "Metrics") is None
+    assert ctrl.try_admit("a", "Metrics") is None
+    assert ctrl.try_admit("a", "Metrics") == "peer_inflight"
+    assert ctrl.try_admit("b", "Metrics") is None
+    assert ctrl.try_admit("b", "Metrics") == "global_inflight"
+    ctrl.release("a")
+    assert ctrl.try_admit("b", "Metrics") is None
+    assert ctrl.snapshot()["inflight_peak"] == 3
+    assert ctrl.snapshot()["inflight_peak"] <= plan.global_inflight
+
+
+def test_bucket_table_capped_against_spun_identities():
+    # a flooder fabricating a fresh source_id per frame must not mint
+    # itself a fresh full-burst bucket per spin (rate-limit bypass) nor
+    # grow the bucket table without bound (memory DoS): past the cap,
+    # spun keys share ONE overflow bucket per class
+    clk = FakeClock()
+    plan = AdmissionPlan(enabled=True, update_rate=2.0, burst_factor=1.0,
+                         global_inflight=10 ** 9, peer_inflight=10 ** 9)
+    ctrl = AdmissionController(plan, clock=clk)
+    ctrl.BUCKET_CAP = 8
+    admitted = 0
+    for i in range(1000):
+        if ctrl.try_admit(("peer", i), "RegisterUpdate") is None:
+            ctrl.release(("peer", i))
+            admitted += 1
+    # the first 8 spun ids each get their own bucket (one admit each
+    # here), the shared overflow bucket grants its burst of 2 to the
+    # remaining 992 spins combined, everything else sheds
+    assert admitted == 8 + 2, admitted
+    assert len(ctrl._buckets) <= 8 + 1
+    assert ctrl.snapshot()["shed"]["rate"] == 1000 - admitted
+
+
+def test_full_buckets_evicted_losslessly_at_cap():
+    # reconnect churn (redials, NAT rebinds) leaves dead connection keys
+    # behind; once idle they refill to FULL burst and become losslessly
+    # evictable — the cap must not saturate permanently, and honest
+    # newcomers must keep getting real buckets
+    clk = FakeClock()
+    plan = AdmissionPlan(enabled=True, update_rate=2.0, burst_factor=1.0,
+                         peer_inflight=10 ** 9, global_inflight=10 ** 9)
+    ctrl = AdmissionController(plan, clock=clk)
+    ctrl.BUCKET_CAP = 8
+    for i in range(8):
+        assert ctrl.try_admit(("conn", i), "RegisterUpdate") is None
+        ctrl.release(("conn", i))
+    assert len(ctrl._buckets) == 8
+    clk.t += 60.0  # every bucket refills to full burst
+    assert ctrl.try_admit(("conn", 99), "RegisterUpdate") is None
+    ctrl.release(("conn", 99))
+    # the stale full buckets were reaped; the newcomer got a REAL bucket
+    assert ("overflow", "update") not in ctrl._buckets
+    assert (("conn", 99), "update") in ctrl._buckets
+    assert len(ctrl._buckets) <= 2
+    assert ctrl.snapshot()["shed_total"] == 0
+
+
+def test_controller_disabled_admits_everything_but_still_counts():
+    ctrl = AdmissionController(AdmissionPlan(enabled=False, peer_inflight=1,
+                                             global_inflight=1))
+    for _ in range(10):
+        assert ctrl.try_admit("x", "RegisterUpdate") is None
+    snap = ctrl.snapshot()
+    assert snap["shed_total"] == 0 and snap["inflight"] == 10
+    assert not snap["enabled"]
+
+
+def test_parking_lot_sheds_oldest_waiter():
+    ctrl = AdmissionController(AdmissionPlan(enabled=True, max_parked=2))
+    t1 = ctrl.park("wait_iteration")
+    t2 = ctrl.park("wait_round_ready")
+    assert len(ctrl.parking) == 2 and t1.shed is None
+    t3 = ctrl.park("wait_iteration")
+    assert t1.shed == "parked_cap", "the OLDEST waiter is the victim"
+    assert t2.shed is None and t3.shed is None
+    assert len(ctrl.parking) == 2 and ctrl.parking.peak == 2
+    ctrl.unpark(t2)
+    ctrl.unpark(t3)
+    snap = ctrl.snapshot()
+    assert snap["shed"]["parked_cap"] == 1
+    assert snap["parked"] == 0 and snap["parked_peak"] == 2
+    # disabled plan: the lot counts but never sheds
+    off = AdmissionController(AdmissionPlan(enabled=False, max_parked=1))
+    toks = [off.park("w") for _ in range(5)]
+    assert all(t.shed is None for t in toks)
+    assert off.snapshot()["parked_peak"] == 5
+
+
+# ----------------------------------------------------- unit: flood fault
+
+
+def test_flood_fault_kind_deterministic_and_enabled():
+    plan = FaultPlan(flood=50)
+    assert plan.enabled
+    act = plan.action(0, 1, "RegisterUpdate")
+    assert act.flood == 50 and not act.benign and act.kind() == "flood"
+    # same inputs, same fate — the schedule stays pure in the seed
+    assert plan.action(0, 1, "RegisterUpdate") == act
+    # flood composes with the seeded kinds: a dropped frame cannot flood
+    mixed = FaultPlan(seed=5, drop=0.5, flood=3)
+    kinds = {mixed.action(0, 1, "X", 0, seq=s).kind() for s in range(64)}
+    assert kinds == {"drop", "flood"}
+    assert FaultPlan().action(0, 1, "X").flood == 0
+
+
+# --------------------------------------------- client path: BusyError
+
+
+def test_call_retries_busy_with_backoff_breaker_never_advances():
+    agent = PeerAgent(_cfg(0, 2, 25600))
+    attempts = []
+
+    async def busy_then_ok(host, port, msg_type, meta, arrays, timeout,
+                           attempt=0, **kw):
+        attempts.append(attempt)
+        if len(attempts) < 3:
+            raise BusyError("admission shed: rate")
+        return {"ok": 1}, {}
+
+    agent.pool.call = busy_then_ok
+    rmeta, _ = asyncio.run(agent._call(1, "RegisterUpdate"))
+    assert rmeta["ok"] == 1
+    assert attempts == [0, 1, 2], "busy replies must be retried w/ backoff"
+    snap = agent.telemetry_snapshot()
+    assert snap["counters"].get("rpc_busy_retry", 0) == 2
+    # THE invariant: busy is not a fault — breaker state untouched
+    assert agent.health.state(1) == faults.CLOSED
+    assert snap["health"].get("1", {}).get("total_failures", 0) == 0
+    assert snap["health"].get("1", {}).get("opens", 0) == 0
+
+
+def test_permanently_busy_peer_gives_up_without_quarantine():
+    agent = PeerAgent(_cfg(0, 2, 25600))
+    calls = []
+
+    async def always_busy(host, port, msg_type, meta, arrays, timeout,
+                          attempt=0, **kw):
+        calls.append(attempt)
+        raise BusyError("admission shed: peer_inflight")
+
+    agent.pool.call = always_busy
+    with pytest.raises(BusyError):
+        asyncio.run(agent._call(1, "RegisterUpdate"))
+    assert len(calls) == 1 + agent.cfg.rpc_retries, "budget fully spent"
+    # alive + closed: a busy peer is healthy, only deprioritized
+    assert 1 in agent.alive
+    assert agent.health.state(1) == faults.CLOSED
+    assert agent._peer_busy(1), "peer must be marked busy for the round"
+    snap = agent.telemetry_snapshot()
+    assert snap["counters"].get("rpc_busy_give_up", 0) == 1
+    assert snap["counters"].get("breaker_open", 0) == 0
+
+
+def test_gossip_fanout_deprioritizes_busy_peer():
+    # 10 peers: fan-out = max(3, log2(9)+1) = 4, fresh targets (8) fill
+    # the draw, so the busy peer must not be advertised to this round
+    agent = PeerAgent(_cfg(0, 10, 25600))
+    busy_pid = 3
+    agent._busy_peers[busy_pid] = agent.iteration
+    sent = []
+
+    async def record(pid, msg_type, meta=None, arrays=None, timeout=None,
+                     retries=None):
+        sent.append(pid)
+        return {}, {}
+
+    agent._call = record
+    blk = agent._empty_block()
+
+    async def go():
+        agent._gossip_block(blk, full=False)
+        await asyncio.sleep(0.3)  # let the advertise tasks run
+
+    asyncio.run(go())
+    assert sent, "no advertise fan-out happened"
+    assert busy_pid not in sent, "busy peer must be deprioritized"
+    assert agent.counters.get("gossip_deprioritize_busy", 0) == 1
+    assert agent.health.state(busy_pid) == faults.CLOSED
+    # when fresh targets CANNOT fill the draw, busy peers top it up —
+    # coverage beats politeness
+    agent2 = PeerAgent(_cfg(0, 4, 25600))
+    for pid in (1, 2, 3):
+        agent2._busy_peers[pid] = agent2.iteration
+    sent2 = []
+
+    async def record2(pid, msg_type, meta=None, arrays=None, timeout=None,
+                      retries=None):
+        sent2.append(pid)
+        return {}, {}
+
+    agent2._call = record2
+
+    async def go2():
+        agent2._gossip_block(agent2._empty_block(), full=False)
+        await asyncio.sleep(0.3)
+
+    asyncio.run(go2())
+    assert sorted(sent2) == [1, 2, 3]
+
+
+def test_wait_for_iteration_sheds_oldest_as_busy():
+    agent = PeerAgent(_cfg(0, 2, 25600,
+                           admission_plan=AdmissionPlan(enabled=True,
+                                                        max_parked=1)))
+
+    async def go():
+        first = asyncio.ensure_future(
+            agent._wait_for_iteration(2, budget=5.0))
+        await asyncio.sleep(0.1)  # first is parked
+        second = asyncio.ensure_future(
+            agent._wait_for_iteration(2, budget=5.0))
+        with pytest.raises(BusyError):
+            await first  # evicted by the newer waiter
+        second.cancel()
+        try:
+            await second
+        except asyncio.CancelledError:
+            pass
+
+    asyncio.run(go())
+    snap = agent.admission.snapshot()
+    assert snap["shed"].get("parked_cap", 0) == 1
+    assert snap["parked"] == 0, "cancelled waiter must unpark"
+    assert snap["parked_peak"] <= 1 + 1  # victim overlaps one tick at most
+
+
+# -------------------------------------------------- transport boundary
+
+
+def test_server_sheds_over_inflight_cap_with_busy_status():
+    port = 25660
+
+    async def go():
+        gate = asyncio.Event()
+
+        async def handler(mt, meta, arrays):
+            await gate.wait()
+            return {"served": 1}, {}
+
+        srv = rpc.RPCServer("127.0.0.1", port, handler)
+        srv.admission = AdmissionController(AdmissionPlan(
+            enabled=True, peer_inflight=2, global_inflight=8,
+            update_rate=1e9, bulk_rate=1e9, control_rate=1e9))
+        await srv.start()
+        pool = rpc.Pool()
+        try:
+            calls = [asyncio.ensure_future(
+                pool.call("127.0.0.1", port, "Metrics", {"source_id": 9},
+                          timeout=5.0))
+                for _ in range(6)]
+            await asyncio.sleep(0.4)  # busy sheds come back immediately
+            gate.set()
+            results = await asyncio.gather(*calls, return_exceptions=True)
+        finally:
+            pool.close()
+            await srv.stop()
+        return srv.admission.snapshot(), results
+
+    snap, results = asyncio.run(go())
+    ok = [r for r in results if isinstance(r, tuple)]
+    busy = [r for r in results if isinstance(r, BusyError)]
+    assert len(ok) == 2 and len(busy) == 4, results
+    assert snap["shed"].get("peer_inflight", 0) == 4
+    assert snap["inflight_peak"] == 2, "cap must bound concurrency"
+    assert snap["inflight"] == 0, "all tickets released"
+
+
+def test_read_deadline_drops_slow_loris_but_not_honest_conns():
+    port = 25670
+
+    async def go():
+        async def handler(mt, meta, arrays):
+            return {"pong": 1}, {}
+
+        srv = rpc.RPCServer("127.0.0.1", port, handler)
+        srv.read_deadline = 0.4
+        await srv.start()
+        try:
+            # slow loris: a frame prefix promising 1000 bytes, then stall
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(struct.pack(">I", 1000) + b"\x00\x00")
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(), 3.0)
+            assert data == b"", "server must DROP the stalled connection"
+            writer.close()
+            # an honest full frame on a fresh connection still works —
+            # the deadline is per-incomplete-frame, not per-connection
+            rmeta, _ = await rpc.call("127.0.0.1", port, "Metrics", {},
+                                      timeout=3.0)
+            assert rmeta.get("pong") == 1
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+def test_read_deadline_chunk_progress_keeps_slow_bulk_transfers_alive():
+    """A legitimate chunked multi-MB transfer on a slow link must NOT be
+    killed: every completed continuation chunk resets the per-frame
+    clock, so only one chunk per window is needed — while total transfer
+    time far exceeds the deadline."""
+    import numpy as np
+
+    from biscotti_tpu.runtime import messages as msgs
+
+    port = 25690
+
+    async def go():
+        got = []
+
+        async def handler(mt, meta, arrays):
+            got.append({k: v.shape for k, v in arrays.items()})
+            return {"pong": 1}, {}
+
+        srv = rpc.RPCServer("127.0.0.1", port, handler)
+        srv.read_deadline = 0.6
+        await srv.start()
+        try:
+            # ~160 KB payload split into 64 KiB continuation chunks
+            blob = msgs.encode("Metrics", {"rid": 1},
+                               {"x": np.zeros(20000, np.float64)},
+                               chunk_bytes=65536)
+            frames = []
+            off = 0
+            while off < len(blob):
+                (n,) = struct.unpack(">I", blob[off: off + 4])
+                frames.append(blob[off: off + 4 + n])
+                off += 4 + n
+            assert len(frames) >= 3, "payload did not chunk"
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            for f in frames:  # one chunk per 0.4 s: total >> deadline
+                writer.write(f)
+                await writer.drain()
+                await asyncio.sleep(0.4)
+            reply = await asyncio.wait_for(reader.read(64), 3.0)
+            assert reply, "server dropped a legitimate chunked transfer"
+            writer.close()
+        finally:
+            await srv.stop()
+        assert got and got[0]["x"] == (20000,)
+
+    asyncio.run(go())
+
+
+def test_read_deadline_zero_keeps_legacy_patience():
+    port = 25680
+
+    async def go():
+        async def handler(mt, meta, arrays):
+            return {}, {}
+
+        srv = rpc.RPCServer("127.0.0.1", port, handler)  # no deadline
+        await srv.start()
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(struct.pack(">I", 1000))
+            await writer.drain()
+            with pytest.raises(asyncio.TimeoutError):
+                # legacy behavior: the half-frame just sits there
+                await asyncio.wait_for(reader.read(), 1.0)
+            writer.close()
+        finally:
+            await srv.stop()
+
+    asyncio.run(go())
+
+
+# --------------------------------------------------- live flood cluster
+
+
+def _flood_cluster_cfgs(n, port, flood, flood_node, admission, **kw):
+    plan = FaultPlan(seed=13)
+    flood_plan = FaultPlan(seed=13, flood=flood)
+    cfgs = []
+    for i in range(n):
+        cfgs.append(_cfg(
+            i, n, port,
+            fault_plan=flood_plan if (flood and i == flood_node) else plan,
+            admission_plan=admission, **kw))
+    return cfgs
+
+
+@pytest.mark.flood
+def test_flood_cluster_sheds_and_completes_with_equal_chains():
+    """Tier-1 flood acceptance (creditcard-sized): a 4-node live cluster
+    with one seeded flooding peer at 50x the honest frame rate completes
+    training with the settled-chain oracle passing, nonzero sheds on the
+    honest peers, inflight/parked peaks bounded by the caps, and no
+    breaker opened by the overload (BusyError never feeds it)."""
+    n, port, flood_node = 4, 25700, 1
+
+    async def go():
+        agents = [PeerAgent(c) for c in _flood_cluster_cfgs(
+            n, port, flood=50, flood_node=flood_node, admission=TIGHT)]
+        return await asyncio.gather(*(a.run() for a in agents))
+
+    results = asyncio.run(go())
+    equal, common, real_blocks = chaos.chain_oracle(results)
+    assert equal and common >= 2 and real_blocks >= 1, \
+        "protocol did not hold under flood"
+    snaps = [r["telemetry"] for r in results]
+    fired = chaos.tally_faults(results)
+    assert fired.get("flood", 0) > 0, f"flood never fired: {fired}"
+    honest = [s for s in snaps if s["node"] != flood_node]
+    shed_honest = sum(s["admission"]["shed_total"] for s in honest)
+    assert shed_honest > 0, \
+        f"honest peers never shed: {[s['admission'] for s in snaps]}"
+    # the shed metric is scrapeable with reason+msg_type labels
+    assert any(s["metrics"].get("biscotti_shed_total", {}).get("series")
+               for s in honest)
+    for s in snaps:
+        a = s["admission"]
+        assert a["inflight_peak"] <= a["caps"]["global_inflight"]
+        assert a["parked_peak"] <= max(1, a["caps"]["max_parked"])
+    # overload must never quarantine an HONEST peer: busy replies feed no
+    # breaker, so honest<->honest links stay pristine. (Opens toward the
+    # FLOODER itself are legitimate — its event loop is drowning in its
+    # own storm and genuine transport timeouts toward it may accrue.)
+    for s in honest:
+        for pid, h in s["health"].items():
+            if int(pid) != flood_node:
+                assert h.get("opens", 0) == 0, (s["node"], pid, h)
+
+
+@pytest.mark.flood
+def test_admission_without_flood_sheds_nothing():
+    """The governance plane must be invisible to an honest cluster: the
+    same admission plan with no flooder records ZERO sheds and the run
+    completes identically."""
+    n, port = 4, 25720
+
+    async def go():
+        agents = [PeerAgent(c) for c in _flood_cluster_cfgs(
+            n, port, flood=0, flood_node=-1, admission=TIGHT)]
+        return await asyncio.gather(*(a.run() for a in agents))
+
+    results = asyncio.run(go())
+    equal, common, real_blocks = chaos.chain_oracle(results)
+    assert equal and real_blocks >= 1
+    for r in results:
+        a = r["telemetry"]["admission"]
+        assert a["shed_total"] == 0, f"honest traffic was shed: {a}"
+        assert r["telemetry"]["counters"].get("breaker_open", 0) == 0
+
+
+# ------------------------------------------------ mnist acceptance (slow)
+
+
+@pytest.mark.slow
+@pytest.mark.flood
+def test_flood_acceptance_mnist_cluster():
+    """ISSUE-5 acceptance: 4-node live mnist cluster, one seeded flooding
+    peer at 50x — training completes (settled-chain-prefix oracle),
+    honest peers shed (nonzero biscotti_shed_total), gauges stay bounded;
+    the same cluster with admission but NO flood sheds nothing and lands
+    a final error within noise of the no-admission baseline; and no
+    honest peer's breaker opens due to BusyError in either run."""
+    n, flood_node = 4, 1
+    kw = dict(dataset="mnist", max_iterations=3)
+
+    def run(port, flood, admission):
+        async def go():
+            agents = [PeerAgent(c) for c in _flood_cluster_cfgs(
+                n, port, flood=flood, flood_node=flood_node,
+                admission=admission, **kw)]
+            return await asyncio.gather(*(a.run() for a in agents))
+
+        return asyncio.run(go())
+
+    # 1. flood + admission: survives, sheds, bounded
+    res_flood = run(25740, 50, TIGHT)
+    equal, common, real_blocks = chaos.chain_oracle(res_flood)
+    assert equal and common >= 2 and real_blocks >= 1
+    snaps = [r["telemetry"] for r in res_flood]
+    assert sum(s["admission"]["shed_total"]
+               for s in snaps if s["node"] != flood_node) > 0
+    for s in snaps:
+        a = s["admission"]
+        assert a["inflight_peak"] <= a["caps"]["global_inflight"]
+        assert a["parked_peak"] <= a["caps"]["max_parked"]
+    # BusyError never feeds the breaker: honest<->honest links stay
+    # pristine (opens toward the drowning flooder itself are legitimate
+    # transport evidence, not a busy-classification failure)
+    for s in snaps:
+        if s["node"] == flood_node:
+            continue
+        for pid, h in s["health"].items():
+            if int(pid) != flood_node:
+                assert h.get("opens", 0) == 0, (s["node"], pid, h)
+    # 2. admission, no flood: zero sheds, no breaker opens at all
+    res_clean = run(25760, 0, TIGHT)
+    equal, _, real_blocks = chaos.chain_oracle(res_clean)
+    assert equal and real_blocks >= 1
+    for r in res_clean:
+        assert r["telemetry"]["admission"]["shed_total"] == 0
+        assert r["telemetry"]["counters"].get("breaker_open", 0) == 0
+    # 3. no-admission baseline: final error within noise
+    res_base = run(25780, 0, AdmissionPlan())
+    equal, _, real_blocks = chaos.chain_oracle(res_base)
+    assert equal and real_blocks >= 1
+    err_clean = res_clean[0]["final_error"]
+    err_base = res_base[0]["final_error"]
+    assert abs(err_clean - err_base) < 0.15, (err_clean, err_base)
+
+
+# ---------------------------------------------------------- obs merging
+
+
+def test_obs_merges_admission_readout():
+    from biscotti_tpu.tools import obs
+
+    snaps = [
+        {"node": 0, "iter": 3,
+         "admission": {"enabled": True, "shed": {"rate": 5},
+                       "shed_total": 5, "inflight": 0, "inflight_peak": 7,
+                       "parked": 0, "parked_peak": 2,
+                       "caps": {"peer_inflight": 32,
+                                "global_inflight": 256, "max_parked": 128}},
+         "metrics": {"biscotti_shed_total": {"series": [
+             {"labels": {"reason": "rate",
+                         "msg_type": "RegisterUpdate"}, "value": 5}]}}},
+        {"node": 1, "iter": 3,
+         "admission": {"enabled": True, "shed": {"rate": 2,
+                                                 "parked_cap": 1},
+                       "shed_total": 3, "inflight": 1, "inflight_peak": 4,
+                       "parked": 0, "parked_peak": 9,
+                       "caps": {"peer_inflight": 32,
+                                "global_inflight": 256, "max_parked": 128}}},
+        {"node": 2, "iter": 3},  # pre-admission snapshot: still merges
+    ]
+    merged = obs.merge_snapshots(snaps)
+    a = merged["admission"]
+    assert a["shed_total"] == 8
+    assert a["shed_by_reason"] == {"rate": 7, "parked_cap": 1}
+    assert a["shed_by_msg_type"] == {"RegisterUpdate": 5}
+    assert a["inflight_peak"] == 7 and a["parked_peak"] == 9
+    assert a["enabled_peers"] == 2
+    assert "admission" in obs.format_table(merged)

@@ -166,10 +166,16 @@ def test_partition_window_heals_and_chain_matches():
 def test_geo_latency_model_and_cluster():
     """WAN/geo operating point (the reference's multi-DC deployment,
     global-deploy-eval): the per-link latency model charges cross-region
-    RPCs only, and a latency-injected cluster still mints equal chains —
-    just slower than loopback."""
-    import time
+    RPCs only, and a latency-injected cluster still mints equal chains.
 
+    De-flaked (documented env-flake since PR 1): the old assertion
+    compared raw wall-clock between the geo and loopback runs, which a
+    loaded CI box inverts at will. The WAN's cost is now asserted on the
+    injected-delay schedule itself — every agent's latency model is
+    wrapped with a charge tally, and the geo cluster must have charged
+    real cross-region seconds while the loopback baseline charged none.
+    That is the quantity the model exists to inject, measured without a
+    race against host load."""
     from biscotti_tpu.runtime.rpc import geo_latency
 
     # region math: 6 peers, 3 regions -> contiguous pairs
@@ -186,24 +192,41 @@ def test_geo_latency_model_and_cluster():
 
         agents = [PeerAgent(_cfg(i, n, port + 20 * regions))
                   for i in range(n)]
+        charged = [0.0]
         if regions > 1:
             for a in agents:
-                a.pool.latency = gl(a.id, a.cfg.base_port, regions, n, rtt)
-        t0 = time.monotonic()
-        results = await asyncio.gather(*(a.run() for a in agents))
-        return results, time.monotonic() - t0
+                model = gl(a.id, a.cfg.base_port, regions, n, rtt)
 
-    # baseline FIRST: it pays the one-time jit compile, so the geo run's
-    # extra wall-clock is attributable to the injected latency alone
-    results_base, wall_base = asyncio.run(go(1))
-    results_geo, wall_geo = asyncio.run(go(2))
+                def tallied(host, p, _model=model):
+                    d = _model(host, p)
+                    charged[0] += d
+                    return d
+
+                a.pool.latency = tallied
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, charged[0]
+
+    results_base, charged_base = asyncio.run(go(1))
+    results_geo, charged_geo = asyncio.run(go(2))
     for results in (results_geo, results_base):
         dumps = [r["chain_dump"] for r in results]
         assert all(d == dumps[0] for d in dumps)
         assert any("ndeltas=0" not in ln
                    for ln in dumps[0].splitlines()[1:])
-    # the injected WAN must actually cost wall-clock
-    assert wall_geo > wall_base, (wall_geo, wall_base)
+    # the injected WAN actually charged cross-region RPCs: at 2 regions a
+    # round's verify/update/gossip traffic must cross the cut repeatedly,
+    # so several round trips' worth of delay is the conservative floor
+    assert charged_base == 0.0
+    assert charged_geo >= 3 * rtt, \
+        f"geo cluster charged almost no cross-region latency: {charged_geo}"
+    # and the schedule reached the transport: client latency histograms
+    # (the telemetry the WAN harness reads) saw the charged delays
+    geo_metrics = [r["telemetry"]["metrics"].get("biscotti_rpc_client_seconds")
+                   for r in results_geo]
+    total_rpc_s = sum(row["sum"] for fam in geo_metrics if fam
+                      for row in fam["series"])
+    assert total_rpc_s >= rtt, \
+        "telemetry latency histogram never saw the injected delays"
 
 
 class VetoedWorker(PeerAgent):
