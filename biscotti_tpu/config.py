@@ -204,6 +204,27 @@ class BiscottiConfig:
     # behavior: admit everything, park without bound).
     admission_plan: AdmissionPlan = field(default_factory=AdmissionPlan)
 
+    # --- pipelined round engine (docs/RUNTIME.md §Pipelined rounds) ---
+    # pipeline=True overlaps work across round boundaries: near-future
+    # intake (iteration ≤ current + pipeline_depth) runs its
+    # committee-independent crypto checks BEFORE parking for the round
+    # (so commitment verification of round r+1 submissions runs while
+    # round r mines), and the miner folds secure-agg intake into the
+    # round's VSS accumulator as waves arrive instead of in one lump at
+    # mint. speculation=True additionally lets a worker start its next
+    # local SGD step + VSS commitment off the just-accepted head while
+    # the round machinery finishes; a fork discards the speculative
+    # products (traced `speculation_discard`). batch_intake=True turns
+    # the miner's per-update plain-mode verification loop into one
+    # batched RLC check per micro-batch (bisection identifies offenders
+    # exactly as the sequential path would). All three default OFF: the
+    # disabled configuration reproduces the pre-pipeline round schedule
+    # bit-for-bit (guarded by tests/test_pipeline.py).
+    pipeline: bool = False
+    pipeline_depth: int = 1
+    speculation: bool = False
+    batch_intake: bool = False
+
     # --- wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md) ---
     # negotiated payload codec for protocol traffic: "raw64" (legacy
     # float64 frames, the default), "f32"/"bf16" (downcast — applied to
@@ -284,6 +305,19 @@ class BiscottiConfig:
                 f"wire_topk={self.wire_topk} must be in (0, 1]")
         if self.wire_chunk_bytes < 0:
             raise ValueError("wire_chunk_bytes must be >= 0")
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        # speculation rides the pipeline plane's block-accept hook; on
+        # its own the knob would silently do nothing — refuse the dead
+        # configuration instead of benchmarking the serial engine under
+        # a flag that claims otherwise (batch_intake IS independent: the
+        # micro-batch and the accumulator settle work without pipeline,
+        # only the per-arrival fold kicks need it)
+        if self.speculation and not self.pipeline:
+            raise ValueError(
+                "speculation=True requires pipeline=True (speculative "
+                "steps are scheduled by the pipelined block-accept hook; "
+                "docs/RUNTIME.md §Pipelined rounds)")
         # an enabled admission plan with nonsensical caps must fail at
         # construction, not mid-round when the first frame is budgeted
         self.admission_plan.validate()
@@ -464,6 +498,26 @@ class BiscottiConfig:
                        help="seconds one inbound frame may stay "
                             "partially received before the connection "
                             "drops (slow-loris bound)")
+        p.add_argument("--pipeline", type=int,
+                       default=int(BiscottiConfig.pipeline),
+                       help="1 overlaps phases across rounds: near-future "
+                            "intake pre-verifies its crypto while the "
+                            "current round mines, miner VSS intake folds "
+                            "incrementally (docs/RUNTIME.md)")
+        p.add_argument("--pipeline-depth", type=int,
+                       default=BiscottiConfig.pipeline_depth,
+                       help="how many rounds ahead intake is accepted for "
+                            "early verification")
+        p.add_argument("--speculation", type=int,
+                       default=int(BiscottiConfig.speculation),
+                       help="1 starts the next local SGD step + "
+                            "commitment speculatively off the freshly "
+                            "accepted head (discarded on fork)")
+        p.add_argument("--batch-intake", type=int,
+                       default=int(BiscottiConfig.batch_intake),
+                       help="1 verifies plain-mode miner intake as one "
+                            "batched RLC commitment check per "
+                            "micro-batch, bisection on failure")
         p.add_argument("--wire-codec", type=str,
                        default=BiscottiConfig.wire_codec,
                        help="payload codec for protocol traffic "
@@ -533,6 +587,10 @@ class BiscottiConfig:
                                       cls.breaker_threshold),
             breaker_cooldown_s=getattr(ns, "breaker_cooldown_s",
                                        cls.breaker_cooldown_s),
+            pipeline=bool(getattr(ns, "pipeline", cls.pipeline)),
+            pipeline_depth=getattr(ns, "pipeline_depth", cls.pipeline_depth),
+            speculation=bool(getattr(ns, "speculation", cls.speculation)),
+            batch_intake=bool(getattr(ns, "batch_intake", cls.batch_intake)),
             wire_codec=getattr(ns, "wire_codec", cls.wire_codec),
             wire_chunk_bytes=getattr(ns, "wire_chunk_bytes",
                                      cls.wire_chunk_bytes),

@@ -85,7 +85,9 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         ]
         lib.ed25519_msm_signed.restype = ctypes.c_int
         lib.ed25519_msm_signed.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            # points arg is c_void_p: accepts bytes AND mutable buffers
+            # (the VSS intake accumulator passes its bytearray zero-copy)
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_void_p,
             ctypes.c_size_t, ctypes.c_char_p,
         ]
         lib.ed25519_vss_rlc_scalars.restype = ctypes.c_int
@@ -118,6 +120,14 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
         lib.ed25519_load_xy_sum_ptrs.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), ctypes.c_size_t,
             ctypes.c_size_t, ctypes.c_char_p,
+        ]
+        lib.ed25519_xy_accum.restype = ctypes.c_int
+        lib.ed25519_xy_accum.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.ed25519_ext_accum.restype = ctypes.c_int
+        lib.ed25519_ext_accum.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
         ]
         if not _selfcheck(lib):
             return None
@@ -368,17 +378,74 @@ def load_xy_sum_ptrs(batches: Sequence, n: int) -> Optional[bytes]:
     return out.raw
 
 
-def msm_signed_raw(scalars_buf: bytes, signs_buf: bytes,
-                   points_buf: bytes, n: int) -> ed.Point:
-    """MSM over pre-packed (magnitude, sign, point) buffers — zero python
-    marshalling on the hot path."""
+def xy_accum(acc: bytearray, xy, n: int) -> Optional[int]:
+    """acc[i] += xy[i] over one n×64B affine grid, acc the mutable
+    n×128B extended accumulator (initialize with load_xy_batch). Returns
+    None on success or the index of the first invalid point, in which
+    case acc is UNTOUCHED (validation is a separate first pass) — the
+    incremental half of load_xy_sum_ptrs, letting a miner fold each
+    worker's commitment grid into the round sum as it arrives."""
     lib = _load()
     assert lib is not None, "native library not built (make -C native)"
-    if (len(points_buf) != 128 * n or len(scalars_buf) != 32 * n
+    if len(acc) != 128 * n:
+        raise ValueError("accumulator length mismatch")
+    xy_addr, xy_len, keep = _buf_addr(xy)
+    if xy_len != 64 * n:
+        raise ValueError("xy buffer length mismatch")
+    raw = (ctypes.c_char * len(acc)).from_buffer(acc)
+    rc = lib.ed25519_xy_accum(ctypes.addressof(raw),
+                              ctypes.c_void_p(xy_addr), n)
+    del keep, raw
+    if rc != 0:
+        return rc - 1
+    return None
+
+
+def ext_accum(acc: bytearray, ext: bytes, n: int) -> None:
+    """acc[i] += ext[i] pointwise over two n×128B extended buffers — the
+    per-wave fold of the incremental intake accumulator."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    if len(acc) != 128 * n or len(ext) != 128 * n:
+        raise ValueError("extended buffer length mismatch")
+    raw = (ctypes.c_char * len(acc)).from_buffer(acc)
+    rc = lib.ed25519_ext_accum(ctypes.addressof(raw),
+                               ctypes.c_char_p(ext), n)
+    del raw
+    if rc != 0:
+        raise RuntimeError(f"native ext_accum failed: {rc}")
+
+
+def scalarmult_noreduce(k: int, p: ed.Point) -> ed.Point:
+    """k·P WITHOUT the mod-q reduction the msm wrapper applies — the
+    subgroup-membership check ℓ·P == identity needs the full group-order
+    scalar to survive (reduced it is 0). k must fit 32 bytes."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    out = ctypes.create_string_buffer(64)
+    rc = lib.ed25519_msm(int(k).to_bytes(32, "little"), _point_bytes(p),
+                         1, out)
+    if rc != 0:
+        raise RuntimeError(f"native scalarmult failed: {rc}")
+    return point_from_xy64(out.raw)
+
+
+def msm_signed_raw(scalars_buf: bytes, signs_buf: bytes,
+                   points_buf, n: int) -> ed.Point:
+    """MSM over pre-packed (magnitude, sign, point) buffers — zero python
+    marshalling on the hot path. points_buf may be bytes OR a mutable
+    buffer (bytearray/numpy) passed zero-copy — the VSS intake
+    accumulator hands its running extended buffer straight in."""
+    lib = _load()
+    assert lib is not None, "native library not built (make -C native)"
+    p_addr, p_len, keep = _buf_addr(points_buf)
+    if (p_len != 128 * n or len(scalars_buf) != 32 * n
             or len(signs_buf) != n):
         raise ValueError("buffer length mismatch")
     out = ctypes.create_string_buffer(64)
-    rc = lib.ed25519_msm_signed(scalars_buf, signs_buf, points_buf, n, out)
+    rc = lib.ed25519_msm_signed(scalars_buf, signs_buf,
+                                ctypes.c_void_p(p_addr), n, out)
+    del keep
     if rc != 0:
         raise RuntimeError(f"native msm failed: {rc}")
     return point_from_xy64(out.raw)

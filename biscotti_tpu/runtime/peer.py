@@ -153,6 +153,25 @@ class RoundState:
     # aggregates over a SECOND, different subset would let a malicious
     # leader difference the two sums and unmask an individual update
     served_part: Optional[List[int]] = None
+    # incremental VSS intake accumulator (cfg.batch_intake,
+    # crypto/commitments.VssIntakeBatch): arriving share slices are
+    # folded into one running point sum in waves, so mint-time
+    # verification is just the RLC settle — the grid-summation lump the
+    # one-shot batch check paid on the critical path amortizes across
+    # the round's network wait. Consumed (set back to None) when a
+    # batch retires; later arrivals start a fresh accumulator.
+    vss_accum: Optional[cm.VssIntakeBatch] = None
+    # plain-mode intake micro-batch (cfg.batch_intake): updates arriving
+    # in a burst after the defense decision wait here ~one event-loop
+    # beat and are verified as ONE batched RLC commitment check, with
+    # bisection identifying offenders exactly as the sequential
+    # recompute would (crypto/commitments.batch_verify_commitments).
+    # A LIST, not a per-sid dict: every submission is verified against
+    # its OWN payload — a Byzantine double-send with the same source_id
+    # but different bytes must not inherit the first copy's verdict
+    plain_pending: List[Tuple[Update, asyncio.Future]] = field(
+        default_factory=list)
+    plain_drainer: Optional[asyncio.Task] = None
     block_done: Optional[asyncio.Event] = None
     tasks: List[asyncio.Task] = field(default_factory=list)
 
@@ -226,6 +245,12 @@ class PeerAgent:
 
         self.timeouts = cfg.timeouts  # already-scaled instance may be passed
         self.pool = rpc.Pool()  # persistent multiplexed connections
+        # outbound dials must never squat on a cluster LISTEN port: on
+        # hosts whose ephemeral range covers the protocol ports a pooled
+        # connection could otherwise hold the source port another
+        # co-hosted peer needs to bind (rpc.open_frame_stream redials)
+        self.pool.avoid_local_ports = frozenset(
+            p for _, p in self.peers.values())
         # wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md): the
         # configured codec pipeline, our advertised capability set, and
         # what each peer advertised back (absent = assume legacy raw64).
@@ -321,6 +346,33 @@ class PeerAgent:
         # strong refs to fire-and-forget tasks: the loop only keeps weak
         # references, so an unreferenced parked task can be GC'd mid-sleep
         self._bg_tasks: Set[asyncio.Task] = set()
+        # speculative next-round worker products (cfg.pipeline +
+        # cfg.speculation): the SGD delta (and, when no state-mutating
+        # transform sits between them, the quantized update + VSS
+        # commitment) for (iteration, base head hash), computed in the
+        # background the moment a block lands. Consumed by _worker_flow
+        # iff the base still matches; a fork discards it with a traced
+        # counter (speculation_discard)
+        self._spec: Optional[Dict] = None
+        self._spec_task: Optional[asyncio.Task] = None
+        self._spec_key: Optional[Tuple[int, bytes]] = None
+        # the (it, head) an inflight _spec_task is actually computing
+        # for — _claim_spec awaits the task only when ITS target matches
+        # (a retargeted _spec_key must not make the worker wait out a
+        # doomed stale speculation)
+        self._spec_task_key: Optional[Tuple[int, bytes]] = None
+        # (iteration, sid) pairs already granted a pipelined
+        # pre-verification — caps early-crypto CPU per round (see
+        # _pipelined_iteration); pruned at every round start
+        self._preverify_gate: Set[Tuple[int, int]] = set()
+        # share-point layouts are fixed for the whole run — built once
+        # instead of per round / per blind-row evaluation (the xs list
+        # was rebuilt on every _vss_blind_rows call and the recovery
+        # Vandermonde per mint; ops/secretshare memoizes the matching
+        # pseudoinverse)
+        self._xs_all = [int(x) - ss.SHARE_OFFSET
+                        for x in range(cfg.total_shares)]
+        self._xs_arr = np.asarray(self._xs_all, np.int64)
         # block hashes whose verifier quorums this peer already
         # authenticated (_block_quorums_ok memo). Entries are keyed on the
         # COMPUTED hash of the verified block, never the sender's claimed
@@ -376,6 +428,19 @@ class PeerAgent:
             self.admission.inflight_total)
         reg.gauge(adm.PARKED_GAUGE, adm.PARKED_HELP).set(
             len(self.admission.parking))
+        # pipelined-round readout (docs/RUNTIME.md §Pipelined rounds):
+        # configured overlap depth plus the speculation ledger — hits are
+        # rounds whose SGD/commit came precomputed, discards are
+        # speculative steps a fork (or head mismatch) threw away
+        reg.gauge("biscotti_pipeline_depth",
+                  "rounds of cross-round phase overlap (0 = serial)").set(
+            self.cfg.pipeline_depth if self.cfg.pipeline else 0)
+        reg.gauge("biscotti_speculation_hits",
+                  "speculative worker steps consumed by the round").set(
+            self.counters.get("speculation_hit", 0))
+        reg.gauge("biscotti_speculation_discards",
+                  "speculative worker steps discarded on fork/mismatch").set(
+            self.counters.get("speculation_discard", 0))
 
     def telemetry_snapshot(self) -> Dict:
         """THE public observability readout — one structured dict serving
@@ -468,10 +533,35 @@ class PeerAgent:
         (commitment, iteration, source) approval message (ref: main.go:1686 —
         the reference counts signatures; its miner-side verify,
         kyber.go:898-925, was written but disabled. Here each claimed
-        (signer, sig) pair is actually verified)."""
+        (signer, sig) pair is actually verified).
+
+        Fast path: the whole quorum in ONE batched RLC Schnorr check
+        (cm.batch_schnorr_verify — a single MSM instead of one
+        double-mult per signature). Honest quorums are all-valid, so the
+        batch passing proves every claimed pair and the count is just
+        len(items); any failure falls back to the original per-signature
+        loop, whose verdict (count the valid subset, tolerate junk
+        entries) is preserved bit-for-bit."""
         msg = self._sig_message(commitment, iteration, source_id)
         verifiers, _, _, _ = self.role_map.committee()
         vset = set(verifiers)
+        need = max(1, (len(vset) + 1) // 2)
+        items: List[Tuple[bytes, bytes, bytes]] = []
+        seen: Set[int] = set()
+        for vid, sig in zip(signers, signatures):
+            if vid not in vset or vid in seen:
+                continue
+            pub = self.node_pubs.get(vid)
+            if not pub:
+                continue
+            seen.add(vid)
+            items.append((pub, msg, sig))
+        if len(items) >= need and cm.batch_schnorr_verify(items):
+            return True
+        # batch failed (or thin): per-signature scan, EXACTLY the
+        # pre-batch semantics — e.g. a duplicate signer whose first
+        # entry is junk but whose second is valid still counts here,
+        # where the deduped batch above could not see the second
         valid: Set[int] = set()
         for vid, sig in zip(signers, signatures):
             if vid not in vset or vid in valid:
@@ -479,7 +569,7 @@ class PeerAgent:
             pub = self.node_pubs.get(vid)
             if pub and cm.schnorr_verify(pub, msg, sig):
                 valid.add(vid)
-        return len(valid) >= max(1, (len(vset) + 1) // 2)
+        return len(valid) >= need
 
     def _peer_for_addr(self, host: str, port: int) -> Optional[int]:
         """(host, port) → peer id, for the fault plane's per-link keying —
@@ -681,14 +771,16 @@ class PeerAgent:
 
     # --------------------------------------------------------------- roles
 
-    def _compute_roles(self) -> None:
-        """Role election for the current iteration (ref: main.go:497-527).
-        FedSys: node 0 is the eternal miner (ref: FedSys/main.go:758-768)."""
+    def _elect_role_map(self) -> R.RoleMap:
+        """The role map the CURRENT chain state elects — pure read, so
+        the speculation plane can ask "will I be a worker next round?"
+        the moment a block lands, before the round machinery runs
+        (ref: main.go:497-527). FedSys: node 0 is the eternal miner
+        (ref: FedSys/main.go:758-768)."""
         cfg = self.cfg
         if cfg.fedsys:
-            self.role_map = R.RoleMap.build(cfg.num_nodes, verifiers=[],
-                                            miners=[0], noisers=[])
-            return
+            return R.RoleMap.build(cfg.num_nodes, verifiers=[],
+                                   miners=[0], noisers=[])
         stake = self.chain.latest_stake_map()
         try:
             verifiers, miners = R.elect_committees(
@@ -703,7 +795,10 @@ class PeerAgent:
                 {i: 1 for i in range(cfg.num_nodes)},
                 self.chain.latest_hash(), cfg.num_verifiers,
                 cfg.num_miners, cfg.num_nodes)
-        self.role_map = R.RoleMap.build(cfg.num_nodes, verifiers, miners)
+        return R.RoleMap.build(cfg.num_nodes, verifiers, miners)
+
+    def _compute_roles(self) -> None:
+        self.role_map = self._elect_role_map()
 
     def _noiser_draw(self) -> R.NoiserDraw:
         """Private stake-weighted noiser lottery + the VRF proof that binds
@@ -831,6 +926,8 @@ class PeerAgent:
         if "host" in meta and "port" in meta:
             self.peers[pid] = (meta["host"], int(meta["port"]))
             self._addr_to_pid[self.peers[pid]] = pid
+            self.pool.avoid_local_ports = frozenset(
+                p for _, p in self.peers.values())
         self.alive.add(pid)
         # wire-plane negotiation: record the caller's codec capability
         # set (absent in a legacy hello → it stays raw64-only) and
@@ -925,6 +1022,10 @@ class PeerAgent:
                         empty=blk.is_empty(), hash=blk.hash.hex()[:16])
             if self.round.block_done and blk.iteration >= self.round.iteration:
                 self.round.block_done.set()
+            # the instant the head moves is the widest overlap window:
+            # start next round's speculative worker precompute NOW, while
+            # this round still evaluates convergence and tears down
+            self._maybe_speculate()
             if gossip:
                 # minted here → full fan-out; received → bounded re-gossip
                 self._gossip_block(blk, full=minted)
@@ -1075,29 +1176,96 @@ class PeerAgent:
             commitment=commitment, accepted=False)
         self._trace("submission_rejected", source=sid, reason=reason)
 
+    def _pipelined_iteration(self, it: int, source) -> bool:
+        """True when this frame may pre-verify: a near-future round
+        (ahead of the current one by at most pipeline_depth), a KNOWN
+        peer id, and the first such frame for (it, sid). The expensive
+        committee-INDEPENDENT checks (the O(d) commitment recompute,
+        VSS digests) then run before the handler parks for the round,
+        overlapping the current round's mining; committee-dependent
+        checks (signature quorums) still wait for the election.
+
+        The (known peer, once per (it, sid)) gate bounds the
+        pre-verification CPU at num_nodes·depth checks per round — the
+        same order the round itself pays — so replayed or sid-spoofed
+        future frames cannot turn early verification into a free MSM
+        amplifier (they just park, and the post-round-start path with
+        its dedup/role gates handles them as before)."""
+        if not (self.cfg.pipeline
+                and self.iteration < it
+                <= self.iteration + self.cfg.pipeline_depth):
+            return False
+        try:
+            sid = int(source)
+        except (TypeError, ValueError):
+            return False
+        if sid not in self.peers:
+            return False
+        key = (it, sid)
+        if key in self._preverify_gate:
+            return False
+        self._preverify_gate.add(key)
+        return True
+
     async def _h_register_update(self, meta, arrays):
         """Miner intake, plain mode (ref: main.go:420-436). The commitment
         is recomputed from the received delta (ref: kyber.go:564-577) and
-        the verifier signature quorum is checked before acceptance."""
+        the verifier signature quorum is checked before acceptance.
+
+        Pipelined (cfg.pipeline): a submission for the NEXT round runs
+        its commitment recompute — the O(d) MSM that dominates plain
+        intake — immediately, while this peer is still mining the
+        current round; only the quorum check (needs the next committee)
+        waits. Batched (cfg.batch_intake): concurrent same-round
+        submissions wait one event-loop beat and are verified as ONE
+        RLC batch with bisection fallback (_drain_plain_batch) — one
+        ~d-point MSM per micro-batch instead of one per update. Both
+        paths produce bit-identical accept/reject verdicts and identical
+        round state to the sequential loop they replace."""
         it = int(meta["iteration"])
         if it < self.iteration:
             raise StaleError()
+        pre_ok: Optional[bool] = None
+        u: Optional[Update] = None
+        if (not self.cfg.fedsys
+                and self._pipelined_iteration(it, meta.get("source_id"))):
+            u = wire.unpack_update(meta, arrays)
+            if len(u.delta) == self.trainer.num_params:
+                with self.tele.span("miner_verify", it=it):
+                    pre_ok = await asyncio.to_thread(
+                        self._verify_plain_commitment, u)
+                self._trace("intake_preverified", source=u.source_id,
+                            ok=pre_ok)
         st = await self._wait_round_ready(it)
         if not self.role_map.is_miner(self.id):
             raise RPCError("not a miner this round")
-        u = wire.unpack_update(meta, arrays)
+        if u is None:  # the pre-verified path already decoded this payload
+            u = wire.unpack_update(meta, arrays)
         if len(u.delta) != self.trainer.num_params:
             raise RPCError("bad update dimension")
         if u.source_id in st.miner_updates or u.source_id in st.miner_rejected:
             return {}, {}
         why = ""
         if not self.cfg.fedsys:  # FedSys carries no crypto (ref: FedSys/)
-            if not await asyncio.to_thread(self._verify_plain_commitment, u):
+            if pre_ok is not None:
+                commit_ok = pre_ok
+            elif self.cfg.batch_intake:
+                commit_ok = await self._plain_commit_batched(st, u)
+            else:
+                with self.tele.span("miner_verify", it=it):
+                    commit_ok = await asyncio.to_thread(
+                        self._verify_plain_commitment, u)
+            if not commit_ok:
                 why = "commitment recompute mismatch"
-            elif self.cfg.verification and not await asyncio.to_thread(
-                    self._verify_sig_quorum, u.commitment, it, u.source_id,
-                    u.signers, u.signatures):
-                why = "verifier signature quorum failed"
+            else:
+                with self.tele.span("sig_check", it=it):
+                    quorum_ok = (not self.cfg.verification
+                                 or await asyncio.to_thread(
+                                     self._verify_sig_quorum, u.commitment,
+                                     it, u.source_id, u.signers,
+                                     u.signatures))
+                if not quorum_ok:
+                    why = "verifier signature quorum failed"
         if why:
             self._reject_source(st, u.source_id, it, u.commitment, why)
             raise RPCError(f"update rejected: {why}")
@@ -1105,6 +1273,66 @@ class PeerAgent:
         self._trace("update_registered", source=u.source_id,
                     have=len(st.miner_updates))
         return {}, {}
+
+    async def _plain_commit_batched(self, st: RoundState, u: Update) -> bool:
+        """Park this update in the round's micro-batch and await its
+        commitment verdict (cfg.batch_intake). The first parker spawns
+        the drainer; everyone arriving within the batch window shares
+        one RLC check — but every submission is verified against its own
+        payload (no verdict sharing, even for a repeated source_id)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        st.plain_pending.append((u, fut))
+        if st.plain_drainer is None or st.plain_drainer.done():
+            st.plain_drainer = loop.create_task(self._drain_plain_batch(st))
+        return await asyncio.shield(fut)
+
+    async def _drain_plain_batch(self, st: RoundState) -> None:
+        """Verify every parked plain-mode update in one batched RLC
+        commitment check; on batch failure bisection narrows to the
+        exact per-update recompute verdicts (find_bad_commitments), so
+        the offender set — and the stake debits it feeds — is identical
+        to the sequential path's. Keyless mode (hash commitments) has no
+        RLC structure; it verifies per update inside one thread hop.
+        Hardened: any unexpected error in the batch machinery falls back
+        to the exact sequential recompute per update, and parked futures
+        are ALWAYS resolved — one malformed submission must not hang the
+        honest batch behind it."""
+        await asyncio.sleep(0.02)  # micro-batch window: let a burst land
+        while st.plain_pending:
+            batch, st.plain_pending = st.plain_pending, []
+            updates = [u for u, _ in batch]
+
+            def run() -> List[bool]:
+                try:
+                    if self.commit_key is not None:
+                        items = [(u.commitment, self._quantize_np(u.delta))
+                                 for u in updates]
+                        if cm.batch_verify_commitments(items,
+                                                       self.commit_key):
+                            return [True] * len(updates)
+                        bad = set(cm.find_bad_commitments(items,
+                                                          self.commit_key))
+                        return [i not in bad for i in range(len(updates))]
+                except Exception:
+                    pass  # exact per-update fallback below
+                return [self._verify_plain_commitment(u) for u in updates]
+
+            try:
+                with self.tele.span("miner_verify", it=st.iteration):
+                    verdicts = await asyncio.to_thread(run)
+            except BaseException as e:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            RPCError(f"intake verification failed: "
+                                     f"{type(e).__name__}"))
+                raise
+            self._trace("plain_batch_verified", n=len(updates),
+                        bad=sum(1 for v in verdicts if not v))
+            for (_, fut), ok in zip(batch, verdicts):
+                if not fut.done():
+                    fut.set_result(ok)
 
     async def _h_register_decline(self, meta, arrays):
         """A sampled worker whose update the verifier committee refused
@@ -1141,15 +1369,29 @@ class PeerAgent:
         batched RLC+MSM before any share is served or aggregated (ref:
         kyber.go:650-673 verifySecret ran a pairing per share at intake).
         Nothing unverified can reach aggregation — it can only sit parked
-        in this round's state until the batch check runs."""
+        in this round's state until the batch check runs.
+
+        Pipelined (cfg.pipeline): a next-round submission runs its
+        committee-independent checks (shapes, VSS digest) before parking
+        for the round; with cfg.batch_intake the registered slice is
+        additionally folded into the round's VSS accumulator in the
+        background, so the grid summation the mint-time batch check
+        needs amortizes across the intake window (_kick_intake_fold)."""
         it = int(meta["iteration"])
         if it < self.iteration:
             raise StaleError()
+        basic: Optional[Tuple[bool, str]] = None
+        commitment = bytes.fromhex(meta.get("commitment", ""))
+        if self._pipelined_iteration(it, meta.get("source_id")):
+            with self.tele.span("intake_validate", it=it):
+                basic = await asyncio.to_thread(
+                    self._check_secret_basic, commitment, arrays)
+            self._trace("intake_preverified", source=meta.get("source_id"),
+                        ok=basic[0])
         st = await self._wait_round_ready(it)
         if not self.role_map.is_miner(self.id):
             raise RPCError("not a miner this round")
         sid = int(meta["source_id"])
-        commitment = bytes.fromhex(meta.get("commitment", ""))
         if sid in st.miner_shares or sid in st.miner_rejected:
             return {}, {}
         rows = np.asarray(arrays.get("share_rows", np.zeros(0)), dtype=np.int64)
@@ -1157,8 +1399,15 @@ class PeerAgent:
                   ss.num_chunks(self.trainer.num_params, self.cfg.poly_size))
         if rows.shape != expect:
             raise RPCError(f"bad share shape {rows.shape} != {expect}")
-        ok, why = await asyncio.to_thread(
-            self._check_secret_intake, commitment, meta, arrays)
+        if basic is None:
+            with self.tele.span("intake_validate", it=it):
+                basic = await asyncio.to_thread(
+                    self._check_secret_basic, commitment, arrays)
+        ok, why = basic
+        if ok:
+            with self.tele.span("sig_check", it=it):
+                ok, why = await asyncio.to_thread(
+                    self._check_secret_quorum, commitment, meta)
         if not ok:
             self._reject_source(st, sid, it, commitment, why)
             raise RPCError(f"secret rejected: {why}")
@@ -1175,13 +1424,38 @@ class PeerAgent:
             pass  # quorum already checked above; records stay sig-less
         self._trace("secret_registered", source=sid,
                     have=len(st.miner_shares))
+        if self.cfg.pipeline and self.cfg.batch_intake:
+            # fold the freshly registered slice (and any other pending
+            # ones) into the round's VSS accumulator while the round's
+            # network wait is still running — the summation lump the
+            # mint-time settle would otherwise pay
+            self._kick_intake_fold(st)
         return {}, {}
 
-    def _check_secret_intake(self, commitment: bytes, meta,
-                             arrays) -> Tuple[bool, str]:
-        """Cheap intake checks for one RegisterSecret payload (runs off the
-        event loop); the share-vs-commitment VSS check itself is deferred to
-        the round's batched verification (_verify_intake)."""
+    def _kick_intake_fold(self, st: RoundState) -> None:
+        """Debounced background incremental _verify_intake pass: at most
+        one in flight (the vss_lock serializes the work; the guard keeps
+        a burst of arrivals from stacking N no-op tasks)."""
+        if st.vss_lock.locked():
+            return  # a fold/settle pass is already running; it will sweep
+
+        async def go():
+            try:
+                await self._verify_intake(st, finalize=False)
+            except Exception:
+                pass  # next finalize pass repeats the sweep
+
+        t = asyncio.get_running_loop().create_task(go())
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+
+    def _check_secret_basic(self, commitment: bytes,
+                            arrays) -> Tuple[bool, str]:
+        """Committee-INDEPENDENT intake checks for one RegisterSecret
+        payload (runs off the event loop): tensor shapes and the VSS
+        digest binding. Safe to run for a near-future round before its
+        committee exists — the pipelined half of the old
+        _check_secret_intake."""
         cfg = self.cfg
         comms = arrays.get("comms")
         blind_rows = arrays.get("blind_rows")
@@ -1198,17 +1472,37 @@ class PeerAgent:
             return False, "bad blind tensor shape"
         if cm.vss_digest(comms) != commitment:
             return False, "commitment digest mismatch"
-        if cfg.verification:
-            try:
-                signers = [int(x) for x in meta.get("signers", [])]
-                sigs = [bytes.fromhex(s) for s in meta.get("signatures", [])]
-            except (ValueError, TypeError):
-                return False, "malformed signature metadata"
-            if not self._verify_sig_quorum(commitment, int(meta["iteration"]),
-                                           int(meta["source_id"]),
-                                           signers, sigs):
-                return False, "verifier signature quorum failed"
         return True, ""
+
+    def _check_secret_quorum(self, commitment: bytes,
+                             meta) -> Tuple[bool, str]:
+        """Committee-DEPENDENT half of the intake check: the verifier
+        signature quorum over the commitment digest (needs this round's
+        elected committee, so it always runs after _wait_round_ready)."""
+        if not self.cfg.verification:
+            return True, ""
+        try:
+            signers = [int(x) for x in meta.get("signers", [])]
+            sigs = [bytes.fromhex(s) for s in meta.get("signatures", [])]
+        except (ValueError, TypeError):
+            return False, "malformed signature metadata"
+        if not self._verify_sig_quorum(commitment, int(meta["iteration"]),
+                                       int(meta["source_id"]),
+                                       signers, sigs):
+            return False, "verifier signature quorum failed"
+        return True, ""
+
+    def _check_secret_intake(self, commitment: bytes, meta,
+                             arrays) -> Tuple[bool, str]:
+        """Cheap intake checks for one RegisterSecret payload — the
+        composed (basic + quorum) form, kept for callers and tests that
+        exercise the whole gate in one hop; the share-vs-commitment VSS
+        check itself is deferred to the round's batched verification
+        (_verify_intake)."""
+        ok, why = self._check_secret_basic(commitment, arrays)
+        if not ok:
+            return ok, why
+        return self._check_secret_quorum(commitment, meta)
 
     def _committee_for(self, stake_map: Dict[int, int],
                        prev_hash: bytes) -> List[int]:
@@ -1314,9 +1608,10 @@ class PeerAgent:
         _, miners, _, _ = self.role_map.committee()
         idx = sorted(miners).index(self.id)
         sl = ss.miner_rows(self.cfg.total_shares, idx, len(miners))
-        return [i - ss.SHARE_OFFSET for i in range(self.cfg.total_shares)][sl]
+        return self._xs_all[sl]
 
-    async def _verify_intake(self, st: RoundState) -> None:
+    async def _verify_intake(self, st: RoundState,
+                             finalize: bool = True) -> None:
         """Round-batched VSS verification of every pending share slice: one
         RLC+MSM for the whole intake; per-worker fallback identifies and
         rejects offenders (ref: kyber.go:650-673 checks share-by-share with
@@ -1324,49 +1619,138 @@ class PeerAgent:
         per ROUND here). Guarded so concurrent GetUpdateList/GetMinerPart
         callers share one pass; shares that arrive WHILE a batch is being
         checked stay pending and are verified by the next sweep of the
-        loop — only the sids actually covered by a batch are retired."""
-        if not st.miner_vss:
+        loop — only the sids actually covered by a batch are retired.
+
+        cfg.batch_intake swaps the one-shot group check for the
+        incremental accumulator (cm.VssIntakeBatch): pending slices are
+        booked + folded in waves (`finalize=False`, kicked per arrival
+        when pipelining), and the mint/serve-time call (`finalize=True`)
+        only settles the accumulated set — the RLC scalar chain and one
+        MSM, the sole crypto left on the critical path. Group semantics,
+        retirement bookkeeping, and rejection evidence are identical to
+        the one-shot path."""
+        if not st.miner_vss and not (finalize and st.vss_accum is not None):
             return
         async with st.vss_lock:
+            if not self.cfg.batch_intake:
+                if not finalize:
+                    return  # seed behavior: one lump at mint/serve time
+                await self._verify_intake_oneshot(st)
+                return
             while st.miner_vss:
-                xs = st.my_xs
-                if xs is None:
+                if st.my_xs is None:
                     st.miner_vss.clear()
                     return
                 pending = {
-                    sid: (comms, xs, st.miner_shares[sid], blinds)
+                    sid: (comms, blinds)
                     for sid, (comms, blinds) in st.miner_vss.items()
                     if sid in st.miner_shares
                 }
                 if not pending:
                     st.miner_vss.clear()
                     return
-                with self.tele.span("miner_verify", it=st.iteration):
-                    ok = await asyncio.to_thread(
-                        cm.vss_verify_multi, list(pending.values()))
-                if ok:
-                    # the whole batch is consistent AS A GROUP: remember who
-                    # was verified together, so partial-batch aggregates are
-                    # re-checked at the aggregation boundary
-                    batch = frozenset(pending)
-                    for sid, inst in pending.items():
-                        st.miner_vss_records[sid] = (inst[0], inst[3])
-                        st.miner_vss_batch[sid] = batch
-                else:
-                    for sid, inst in pending.items():
-                        if await asyncio.to_thread(cm.vss_verify_multi,
-                                                   [inst]):
-                            # single-instance checks are exact — the sid is
-                            # individually consistent, a singleton batch
-                            st.miner_vss_records[sid] = (inst[0], inst[3])
-                            st.miner_vss_batch[sid] = frozenset((sid,))
-                            continue
-                        st.miner_shares.pop(sid, None)
-                        commitment = st.miner_commitments.pop(sid, b"")
-                        self._reject_source(st, sid, st.iteration, commitment,
-                                            "share rows fail VSS verification")
+                if st.vss_accum is None:
+                    cfg = self.cfg
+                    st.vss_accum = cm.VssIntakeBatch(
+                        cfg.shares_per_miner,
+                        ss.num_chunks(self.trainer.num_params, cfg.poly_size),
+                        cfg.poly_size)
+                acc = st.vss_accum
+                with self.tele.span("intake_fold", it=st.iteration):
+                    for sid, (comms, blinds) in pending.items():
+                        booked = await asyncio.to_thread(
+                            acc.add, sid, comms, st.miner_shares[sid], blinds)
+                        if not booked:
+                            self._vss_reject(st, sid,
+                                             "share rows fail VSS "
+                                             "verification")
+                    for sid in await asyncio.to_thread(acc.fold):
+                        self._vss_reject(st, sid,
+                                         "share rows fail VSS verification")
                 for sid in pending:
                     st.miner_vss.pop(sid, None)
+            if not finalize:
+                return
+            acc = st.vss_accum
+            if acc is None or not len(acc):
+                return
+            xs = st.my_xs
+            if xs is None:
+                st.vss_accum = None
+                return
+            with self.tele.span("miner_verify", it=st.iteration):
+                ok = await asyncio.to_thread(acc.verify, xs)
+            members = acc.members()
+            self._trace("vss_batch_settled", n=len(members), ok=ok)
+            if ok:
+                # the whole accumulated set is consistent AS A GROUP —
+                # same retirement bookkeeping as the one-shot batch
+                batch = frozenset(members)
+                for sid, (comms, _rows, blinds) in members.items():
+                    st.miner_vss_records[sid] = (comms, blinds)
+                    st.miner_vss_batch[sid] = batch
+            else:
+                for sid, (comms, rows, blinds) in members.items():
+                    if await asyncio.to_thread(cm.vss_verify_multi,
+                                               [(comms, xs, rows, blinds)]):
+                        st.miner_vss_records[sid] = (comms, blinds)
+                        st.miner_vss_batch[sid] = frozenset((sid,))
+                        continue
+                    self._vss_reject(st, sid,
+                                     "share rows fail VSS verification")
+            # retired: later arrivals start a fresh accumulator (and a
+            # fresh batch, exactly like a second one-shot sweep would)
+            st.vss_accum = None
+
+    def _vss_reject(self, st: RoundState, sid: int, why: str) -> None:
+        st.miner_shares.pop(sid, None)
+        commitment = st.miner_commitments.pop(sid, b"")
+        self._reject_source(st, sid, st.iteration, commitment, why)
+
+    async def _verify_intake_oneshot(self, st: RoundState) -> None:
+        """The pre-accumulator verification body (cfg.batch_intake off):
+        one vss_verify_multi lump per sweep — kept verbatim as the seed
+        round schedule the disabled configuration must reproduce."""
+        while st.miner_vss:
+            xs = st.my_xs
+            if xs is None:
+                st.miner_vss.clear()
+                return
+            pending = {
+                sid: (comms, xs, st.miner_shares[sid], blinds)
+                for sid, (comms, blinds) in st.miner_vss.items()
+                if sid in st.miner_shares
+            }
+            if not pending:
+                st.miner_vss.clear()
+                return
+            with self.tele.span("miner_verify", it=st.iteration):
+                ok = await asyncio.to_thread(
+                    cm.vss_verify_multi, list(pending.values()))
+            self._trace("vss_batch_settled", n=len(pending), ok=ok)
+            if ok:
+                # the whole batch is consistent AS A GROUP: remember who
+                # was verified together, so partial-batch aggregates are
+                # re-checked at the aggregation boundary
+                batch = frozenset(pending)
+                for sid, inst in pending.items():
+                    st.miner_vss_records[sid] = (inst[0], inst[3])
+                    st.miner_vss_batch[sid] = batch
+            else:
+                for sid, inst in pending.items():
+                    if await asyncio.to_thread(cm.vss_verify_multi,
+                                               [inst]):
+                        # single-instance checks are exact — the sid is
+                        # individually consistent, a singleton batch
+                        st.miner_vss_records[sid] = (inst[0], inst[3])
+                        st.miner_vss_batch[sid] = frozenset((sid,))
+                        continue
+                    st.miner_shares.pop(sid, None)
+                    commitment = st.miner_commitments.pop(sid, b"")
+                    self._reject_source(st, sid, st.iteration, commitment,
+                                        "share rows fail VSS verification")
+            for sid in pending:
+                st.miner_vss.pop(sid, None)
 
     async def _ensure_subset_consistent(self, st: RoundState,
                                         nodes: List[int]) -> bool:
@@ -1617,17 +2001,135 @@ class PeerAgent:
         agg = np.asarray(ss.aggregate_shares(stack))
         return {"nodes": nodes}, {"agg_rows": agg}
 
+    # --------------------------------------------------- speculation plane
+
+    def _maybe_speculate(self) -> None:
+        """Kick the speculative next-round worker precompute the moment a
+        block lands (cfg.pipeline + cfg.speculation): SGD off the fresh
+        head — and, when no state-mutating transform sits between the
+        delta and the commitment, the quantize + VSS commit too — runs
+        in the background while this peer still evaluates convergence,
+        flushes telemetry, and elects the next committees. One slot,
+        keyed (iteration, head hash); a stale unconsumed slot is a
+        speculative step a fork threw away (speculation_discard)."""
+        cfg = self.cfg
+        if not (cfg.pipeline and cfg.speculation) or cfg.fedsys:
+            return
+        if self.stepper is not None:
+            # peers-as-devices mode memoizes the batched SGD per
+            # ITERATION (device_cluster._memo): a speculative call off a
+            # head that later forks would poison the whole co-hosted
+            # group's cache for the real round — speculation stays a
+            # per-agent-trainer feature
+            return
+        it = self.iteration
+        if it >= cfg.max_iterations or self.converged:
+            return
+        head = self.chain.latest_hash()
+        key = (it, head)
+        if self._spec_key == key and (
+                self._spec is not None
+                or (self._spec_task is not None
+                    and not self._spec_task.done())):
+            return  # already speculated (or speculating) off this head
+        if self._spec is not None:
+            # an unconsumed speculative step against a superseded head:
+            # the fork/rollback case the counter exists for
+            self._spec = None
+            self._trace("speculation_discard")
+        self._spec_key = key
+        if self._spec_task is not None and not self._spec_task.done():
+            # one speculative step in flight at a time: a catch-up storm
+            # accepting N blocks back-to-back must not fan out N SGD
+            # threads. The inflight task's store-guard drops its stale
+            # result; the NEXT block accept (or the round itself)
+            # proceeds serially — a missed speculation, never a wrong one
+            return
+        t = asyncio.get_running_loop().create_task(self._speculate(it, head))
+        self._spec_task = t
+        self._spec_task_key = key
+        self._bg_tasks.add(t)
+        t.add_done_callback(self._bg_tasks.discard)
+
+    async def _speculate(self, it: int, head: bytes) -> None:
+        cfg = self.cfg
+        try:
+            if not self._elect_role_map().is_vanilla(self.id):
+                return  # committee duty next round: nothing to precompute
+            w = self.chain.latest_gradient()
+            with self.tele.span("spec_sgd", it=it):
+                delta = await asyncio.to_thread(self.trainer.private_fun,
+                                                w, it)
+            if self.chain.latest_hash() != head:
+                self._trace("speculation_discard")
+                return
+            spec: Dict = {"it": it, "base": head, "delta": delta}
+            if (cfg.secure_agg and not cfg.fedsys and not cfg.dp_in_model
+                    and not self.wire.lossy):
+                # delta reaches quantization unchanged on this config, so
+                # the VSS chunk commitments are speculatable too — the
+                # dominant worker-crypto cost. The context is pinned to
+                # the speculated head, and _worker_flow re-checks q
+                # equality before reuse, so a hit is bit-identical to
+                # the serial computation.
+                q = self._quantize_np(delta)
+                with self.tele.span("spec_commit", it=it):
+                    vss = await asyncio.to_thread(self._vss_build, q, it,
+                                                  head)
+                if self.chain.latest_hash() != head:
+                    self._trace("speculation_discard")
+                    return
+                spec["q"] = q
+                spec["vss"] = vss
+            if self._spec_key == (it, head):
+                self._spec = spec
+                self._trace("speculation_ready")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._trace("speculation_error",
+                        error=f"{type(e).__name__}: {e}")
+
+    async def _claim_spec(self, it: int) -> Optional[Dict]:
+        """Hand the speculative products to the round's worker flow iff
+        they were computed off exactly the head this round builds on;
+        anything else is discarded with the traced counter. Awaits an
+        in-flight matching speculation first — that is the same work the
+        serial path would do inline, already mid-flight."""
+        t = self._spec_task
+        if (t is not None and not t.done()
+                and self._spec_task_key == (it, self.chain.latest_hash())):
+            # the inflight task is computing for EXACTLY this head:
+            # awaiting it is the same work the serial path would do
+            # inline. A task retargeted away (fork mid-speculation) is
+            # NOT awaited — its result is doomed, the serial path below
+            # proceeds immediately
+            await asyncio.shield(t)
+        spec, self._spec = self._spec, None
+        if spec is None:
+            return None
+        if spec["it"] == it and spec["base"] == self.chain.latest_hash():
+            self._trace("speculation_hit")
+            return spec
+        self._trace("speculation_discard")
+        return None
+
     # --------------------------------------------------------------- worker
 
     async def _worker_flow(self) -> None:
         cfg = self.cfg
         it = self.iteration
         st = self.round
+        spec = None
+        if cfg.pipeline and cfg.speculation and not cfg.fedsys:
+            spec = await self._claim_spec(it)
         w = self.chain.latest_gradient()
         # heavy device call off the event loop: in-process clusters share one
         # loop, and a blocked loop starves every peer's timers
         with self.tele.span("sgd", it=it):
-            if self.stepper is not None:
+            if spec is not None:
+                delta = spec["delta"]  # precomputed off this exact head
+            elif self.stepper is not None:
                 delta = await self.stepper.step(self.id, w, it)
             else:
                 delta = await asyncio.to_thread(self.trainer.private_fun,
@@ -1684,8 +2186,15 @@ class PeerAgent:
             # commitment = digest over the per-chunk Pedersen VSS coefficient
             # commitments: the exact object miners verify share rows against,
             # so verifier signatures and share verification bind together
-            with self.tele.span("crypto_commit", it=it):
-                vss = await asyncio.to_thread(self._vss_build, q, it)
+            if (spec is not None and spec.get("vss") is not None
+                    and np.array_equal(spec["q"], q)):
+                # speculated off this exact head AND the quantized update
+                # matches bit-for-bit: the precomputed commitment IS the
+                # serial one (same q, same context)
+                vss = spec["vss"]
+            else:
+                with self.tele.span("crypto_commit", it=it):
+                    vss = await asyncio.to_thread(self._vss_build, q, it)
             commitment = cm.vss_digest(vss[0])
         else:
             with self.tele.span("crypto_commit", it=it):
@@ -1777,19 +2286,24 @@ class PeerAgent:
             ))
         self._trace("update_sent", secure_agg=cfg.secure_agg)
 
-    def _vss_build(self, q: np.ndarray, it: int) -> Tuple[np.ndarray, bytes, int]:
+    def _vss_build(self, q: np.ndarray, it: int,
+                   head: Optional[bytes] = None) -> Tuple[np.ndarray, bytes, int]:
         """Pedersen-VSS commitments for every polynomial chunk of the
         quantized update, bound to this round via the (block hash,
         iteration) context. Returns (comms uint8 [C,k,64] affine pairs,
         packed blind coefficients, chunk count). The blinding-SHARE tensor
         is evaluated later, post-approval (_vss_blind_rows): only accepted
-        updates ship shares, so rejected workers skip that cost."""
+        updates ship shares, so rejected workers skip that cost.
+        `head` pins the context hash for the speculative caller, which
+        must not race a mid-build chain advance; None reads the live
+        chain (the serial path)."""
         cfg = self.cfg
         c = ss.num_chunks(len(q), cfg.poly_size)
         padded = np.zeros(c * cfg.poly_size, np.int64)
         padded[: len(q)] = q
         chunks = padded.reshape(c, cfg.poly_size)
-        context = self.chain.latest_hash() + int(it).to_bytes(8, "little")
+        context = ((head if head is not None else self.chain.latest_hash())
+                   + int(it).to_bytes(8, "little"))
         comms, blind_bytes = cm.vss_commit_chunks_bytes(
             chunks, self.schnorr_seed, context)
         return comms, blind_bytes, c
@@ -1797,9 +2311,8 @@ class PeerAgent:
     def _vss_blind_rows(self, blind_bytes: bytes, c: int) -> np.ndarray:
         """Blinding-polynomial share tensor uint8 [S,C,32] for all share
         points (the post-approval half of _vss_build)."""
-        cfg = self.cfg
-        xs = [int(x) - ss.SHARE_OFFSET for x in range(cfg.total_shares)]
-        return cm.vss_blind_rows_bytes(blind_bytes, c, cfg.poly_size, xs)
+        return cm.vss_blind_rows_bytes(blind_bytes, c, self.cfg.poly_size,
+                                       self._xs_all)
 
     def _secret_arrays(self, shares: np.ndarray, blind_rows: np.ndarray,
                        comms: np.ndarray, sl: slice) -> Dict[str, np.ndarray]:
@@ -1940,7 +2453,7 @@ class PeerAgent:
                     return self._empty_block()
                 # 3. reassemble rows and recover the aggregate
                 full = np.concatenate([slices[i] for i in range(len(miners))])
-                xs = np.asarray(ss.share_xs(cfg.total_shares))
+                xs = self._xs_arr
                 with self.tele.span("recovery", it=it):
                     agg = np.asarray(ss.recover_update(
                         full, xs, self.trainer.num_params, cfg.poly_size,
@@ -2055,6 +2568,11 @@ class PeerAgent:
             krum_decision=loop.create_future(),
             block_done=asyncio.Event(),
         )
+        if self._preverify_gate:
+            # entries for settled rounds are dead weight; live near-future
+            # entries survive so their one-shot grant still holds
+            self._preverify_gate = {k for k in self._preverify_gate
+                                    if k[0] >= it}
         st = self.round
         if self.role_map.is_miner(self.id) and self.cfg.secure_agg:
             st.my_xs = self._my_share_xs()
@@ -2143,7 +2661,11 @@ class PeerAgent:
                 err = await asyncio.to_thread(self.trainer.test_error,
                                               self.chain.latest_gradient())
         self.logs.append((it, err, time.time()))
-        self._trace("round_end", error=err)
+        # height pins the event to the round just finished: the implicit
+        # iter stamp has already advanced past the accepted block, which
+        # would credit this round's end to the NEXT round's ledger
+        # (tools/profile_round keys its wall-clock table on it)
+        self._trace("round_end", error=err, height=it)
         if err < cfg.convergence_error:
             self.converged = True
         # round boundary = the recorder's durability point (its spill is
@@ -2260,7 +2782,12 @@ class PeerAgent:
         except asyncio.CancelledError:
             # routine teardown (a harness cancelling the task, Ctrl-C):
             # drain the batched spill so the event log is complete, but a
-            # cancellation is not a crash — no forensic dump
+            # cancellation is not a crash — no forensic dump. The RPC
+            # server's listen socket is released SYNCHRONOUSLY: left to
+            # GC it stays bound for an unbounded grace period, and the
+            # next cluster on this port fails its bind
+            self.server.close_now()
+            self.pool.close()
             if self._metrics_server is not None:
                 self._metrics_server.close()
             self.tele.close()
@@ -2271,6 +2798,8 @@ class PeerAgent:
             # never had — dump the ring beside the spill file and flush
             # whatever the batch buffer still holds, then re-raise
             self.tele.crash_dump(reason=f"{type(e).__name__}: {e}")
+            self.server.close_now()
+            self.pool.close()
             if self._metrics_server is not None:
                 self._metrics_server.close()
             self.tele.close()

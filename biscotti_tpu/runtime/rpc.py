@@ -35,7 +35,9 @@ carry the request's rid so out-of-order completion is fine.
 from __future__ import annotations
 
 import asyncio
+import errno
 import struct
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -330,10 +332,32 @@ class FrameStream(asyncio.BufferedProtocol):
             self.transport.close()
 
 
-async def open_frame_stream(host: str, port: int) -> FrameStream:
+async def open_frame_stream(host: str, port: int,
+                            avoid_local_ports=frozenset()) -> FrameStream:
+    """Dial a peer. `avoid_local_ports` lists LISTEN ports of the local
+    cluster: on hosts whose ephemeral range covers the protocol ports
+    (ip_local_port_range 16000-65535 here), the kernel can hand an
+    outbound socket the very source port a co-hosted peer needs to bind
+    — and a pooled connection then squats on it for the whole run. When
+    the assigned source port is one of those, redial; the doomed sockets
+    are held until a clean one lands so the kernel cannot re-deal the
+    same port, then closed."""
     loop = asyncio.get_running_loop()
-    _, proto = await loop.create_connection(lambda: FrameStream(), host, port)
-    return proto
+    doomed = []
+    try:
+        for attempt in range(16):
+            tr, proto = await loop.create_connection(lambda: FrameStream(),
+                                                     host, port)
+            sockname = tr.get_extra_info("sockname")
+            if (not avoid_local_ports or sockname is None
+                    or sockname[1] not in avoid_local_ports
+                    or attempt == 15):  # budget spent: squat over failure
+                return proto
+            doomed.append(tr)
+        raise AssertionError("unreachable")
+    finally:
+        for tr in doomed:
+            tr.close()
 
 
 class RPCServer:
@@ -357,22 +381,51 @@ class RPCServer:
         self.admission = None
         self.read_deadline = 0.0
 
-    async def start(self) -> None:
+    async def start(self, bind_budget_s: float = 10.0) -> None:
+        """Bind the listen socket, retrying transient EADDRINUSE.
+
+        On hosts whose ephemeral range covers the protocol ports (this
+        box: ip_local_port_range 16000-65535, protocol ports 8000+/25xxx
+        in harnesses), any peer's OUTBOUND connection can be randomly
+        assigned the very source port another peer is about to LISTEN
+        on; SO_REUSEADDR does not help against an active socket. The
+        collision is transient — the client socket moves on within the
+        connection's lifetime — so a brief retry turns a startup crash
+        into a short delay. A port genuinely held by another server
+        still fails, after `bind_budget_s`."""
         loop = asyncio.get_running_loop()
-        self._server = await loop.create_server(
-            lambda: FrameStream(on_connected=self._on_conn,
-                                read_deadline=self.read_deadline),
-            self.host, self.port)
+        deadline = time.monotonic() + bind_budget_s
+        while True:
+            try:
+                self._server = await loop.create_server(
+                    lambda: FrameStream(on_connected=self._on_conn,
+                                        read_deadline=self.read_deadline),
+                    self.host, self.port)
+                return
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE \
+                        or time.monotonic() >= deadline:
+                    raise
+                await asyncio.sleep(0.2)
+
+    def close_now(self) -> None:
+        """Synchronous teardown: release the LISTENING socket immediately
+        and cancel live handlers, without awaiting wait_closed(). For
+        exception/cancellation paths that cannot await — leaving the
+        listen fd to garbage collection keeps the port bound for an
+        unbounded grace period (observed as address-already-in-use
+        flakes when back-to-back harness clusters reuse a port)."""
+        if self._server is not None:
+            self._server.close()
+        for t in list(self._conn_tasks):
+            t.cancel()
 
     async def stop(self) -> None:
         # cancel live connection handlers BEFORE wait_closed(): since 3.12
         # wait_closed waits for every handler to finish, and handlers on
         # persistent pooled connections run until the remote side closes —
         # waiting first would deadlock two peers stopping simultaneously
-        if self._server is not None:
-            self._server.close()
-        for t in list(self._conn_tasks):
-            t.cancel()
+        self.close_now()
         if self._server is not None:
             try:
                 await asyncio.wait_for(self._server.wait_closed(), 5.0)
@@ -718,6 +771,12 @@ class Pool:
         # round latency becomes attributable to transport vs. compute
         # per link (the Garfield-style breakdown, PAPERS.md).
         self.metrics = None
+        # LISTEN ports of the local cluster (set by the peer agent):
+        # outbound dials refuse a kernel-assigned source port from this
+        # set — on hosts whose ephemeral range covers the protocol
+        # ports, a persistent pooled connection could otherwise squat on
+        # a port a co-hosted peer needs to bind (see open_frame_stream)
+        self.avoid_local_ports: frozenset = frozenset()
 
     def _evict(self, exempt: Optional[Tuple[str, int]] = None) -> None:
         # drop dead connections regardless of the cap, then close idle
@@ -744,7 +803,8 @@ class Pool:
             excess -= 1
 
     async def _dial(self, key: Tuple[str, int]) -> _Conn:
-        conn = _Conn(await open_frame_stream(*key))
+        conn = _Conn(await open_frame_stream(
+            *key, avoid_local_ports=self.avoid_local_ports))
         conn.metrics = self.metrics
         self._conns[key] = conn
         self._conns.move_to_end(key)

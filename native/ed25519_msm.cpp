@@ -1162,6 +1162,81 @@ int ed25519_load_xy_sum_ptrs(const uint8_t *const *batches,
   return load_xy_sum_core(batches, n_batches, n, out);
 }
 
+// Incremental form of load_xy_sum: acc[i] += xy[i] for one n×64B affine
+// grid, acc held as the n×128B extended buffer the one-shot loaders
+// emit (and msm_signed consumes). This is what lets a miner fold each
+// worker's commitment grid into the round's running sum AT INTAKE TIME —
+// the O(W·n) validate+add work amortizes across the round's arrivals and
+// only the final RLC MSM stays on the mint critical path.
+//
+// All-or-nothing: pass 1 validates every point (canonical + on-curve,
+// same load_affine_checked as the one-shot loaders), pass 2 accumulates;
+// a bad grid returns 1+index with `acc` UNTOUCHED, so the caller can
+// reject the one worker without poisoning the round's accumulator.
+int ed25519_xy_accum(uint8_t *acc, const uint8_t *xy, size_t n) {
+  if (n == 0) return 1;
+  std::atomic<size_t> first_bad{SIZE_MAX};
+  parallel_slices(n, 4096, [&](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; i++) {
+      if (first_bad.load(std::memory_order_relaxed) != SIZE_MAX) return;
+      fe x, y, t;
+      if (!load_affine_checked(xy + i * 64, x, y, t)) {
+        size_t cur = first_bad.load(std::memory_order_relaxed);
+        while (i < cur && !first_bad.compare_exchange_weak(cur, i)) {
+        }
+        return;
+      }
+    }
+  });
+  if (first_bad.load() != SIZE_MAX) return (int)(first_bad.load() + 1);
+  parallel_slices(n, 4096, [&](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; i++) {
+      // points were validated above; reload without the curve check
+      const uint8_t *p = xy + i * 64;
+      fe x = fe_frombytes(p);
+      fe y = fe_frombytes(p + 32);
+      fe t = fe_mul(x, y);
+      uint8_t *o = acc + i * 128;
+      ge a{fe_frombytes(o), fe_frombytes(o + 32), fe_frombytes(o + 64),
+           fe_frombytes(o + 96)};
+      nge q{fe_add(y, x), fe_sub(y, x), fe_mul(t, D2)};
+      a = ge_madd(a, q);
+      fe_tobytes(o, a.X);
+      fe_tobytes(o + 32, a.Y);
+      fe_tobytes(o + 64, a.Z);
+      fe_tobytes(o + 96, a.T);
+    }
+  });
+  return 0;
+}
+
+// Pointwise extended+extended accumulation: acc[i] = acc[i] + ext[i]
+// over two n×128B extended buffers. The companion to ed25519_xy_accum
+// for WAVE-batched intake: a miner sums each arrival wave of affine
+// grids through the vectorized load_xy_sum path (batch-innermost, IFMA
+// where available) and folds the resulting extended wave sum into the
+// round accumulator with this one 9-mul-add pass — per-wave instead of
+// per-grid, so the fold cost amortizes to ~1/W of the wave work.
+int ed25519_ext_accum(uint8_t *acc, const uint8_t *ext, size_t n) {
+  if (n == 0) return 1;
+  parallel_slices(n, 4096, [&](size_t lo, size_t hi) {
+    for (size_t i = lo; i < hi; i++) {
+      uint8_t *o = acc + i * 128;
+      const uint8_t *p = ext + i * 128;
+      ge a{fe_frombytes(o), fe_frombytes(o + 32), fe_frombytes(o + 64),
+           fe_frombytes(o + 96)};
+      ge b{fe_frombytes(p), fe_frombytes(p + 32), fe_frombytes(p + 64),
+           fe_frombytes(p + 96)};
+      a = ge_add(a, b);
+      fe_tobytes(o, a.X);
+      fe_tobytes(o + 32, a.Y);
+      fe_tobytes(o + 64, a.Z);
+      fe_tobytes(o + 96, a.T);
+    }
+  });
+  return 0;
+}
+
 // Batch point decompression, RFC 8032 rules (mirrors the pure-python
 // ed25519.point_decompress exactly): in n×32B compressed points, out
 // n×128B extended (X, Y, Z=1, T). Returns 0 when all decode, else

@@ -1,0 +1,416 @@
+"""Pipelined round engine + batched miner crypto (ISSUE 6).
+
+Covers the two stacked attacks and their off-switches:
+
+* pipelining — cross-round overlap (early intake pre-verification,
+  speculative worker precompute with fork rollback) leaves chains
+  bit-identical to the serial engine, under chaos included;
+* batching — the miner's plain-mode intake verifies as ONE RLC batch
+  with bisection fallback, and the secure-agg intake folds into the
+  round's VSS accumulator, both producing the sequential path's exact
+  accept/reject verdicts;
+* disabled knobs (the default) reproduce the seed round schedule: no
+  pipeline-plane counters, no new phases, and the config surface
+  defaults everything off.
+"""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
+from biscotti_tpu.crypto import commitments as cm
+from biscotti_tpu.ledger.block import Block, BlockData, Update
+from biscotti_tpu.ops import secretshare as ss
+from biscotti_tpu.parallel import roles as R
+from biscotti_tpu.runtime import wire
+from biscotti_tpu.runtime.faults import FaultPlan
+from biscotti_tpu.runtime.peer import PeerAgent, RoundState
+from biscotti_tpu.runtime.rpc import RPCError
+from biscotti_tpu.tools import chaos, profile_round
+
+pytestmark = pytest.mark.pipeline
+
+FAST = Timeouts(update_s=4.0, block_s=14.0, krum_s=3.0, share_s=4.0,
+                rpc_s=6.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        defense=Defense.KRUM, max_iterations=3, convergence_error=0.0,
+        sample_percent=1.0, batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+# ------------------------------------------------------------ config knobs
+
+
+def test_pipeline_knobs_default_off_and_ride_the_cli():
+    import argparse
+
+    cfg = BiscottiConfig()
+    assert (cfg.pipeline, cfg.speculation, cfg.batch_intake) == (
+        False, False, False), "pipeline plane must default to the seed"
+    ap = argparse.ArgumentParser()
+    BiscottiConfig.add_args(ap)
+    ns = ap.parse_args(["--pipeline", "1", "--pipeline-depth", "2",
+                        "--speculation", "1", "--batch-intake", "1"])
+    got = BiscottiConfig.from_args(ns)
+    assert got.pipeline and got.speculation and got.batch_intake
+    assert got.pipeline_depth == 2
+    with pytest.raises(ValueError):
+        BiscottiConfig(pipeline_depth=0)
+    with pytest.raises(ValueError):
+        # speculation without the pipeline plane would silently no-op;
+        # the dead configuration is refused at construction
+        BiscottiConfig(speculation=True)
+    BiscottiConfig(batch_intake=True)  # batching IS independent
+
+
+# ------------------------------------------- seed-schedule guard (disabled)
+
+
+def test_disabled_knobs_reproduce_seed_schedule():
+    """Default config = no pipeline plane: no speculative steps, no early
+    pre-verification, no micro-batches, no accumulator folds — the round
+    schedule is the pre-PR one (the chains-equal test below separately
+    proves the enabled engine lands on the same chains)."""
+    n, port = 4, 26110
+    cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
+                 max_iterations=2) for i in range(n)]
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        return await asyncio.gather(*(a.run() for a in agents))
+
+    results = asyncio.run(go())
+    equal, common, _ = chaos.chain_oracle(results)
+    assert equal and common >= 1
+    for r in results:
+        counters = r["counters"]
+        for forbidden in ("speculation_hit", "speculation_discard",
+                          "speculation_ready", "intake_preverified",
+                          "plain_batch_verified"):
+            assert forbidden not in counters, \
+                f"pipeline-plane counter {forbidden} fired with knobs off"
+        for phase in ("intake_fold", "spec_sgd", "spec_commit"):
+            assert phase not in r["phases"], \
+                f"pipeline-plane phase {phase} charged with knobs off"
+        assert r["telemetry"]["metrics"]["biscotti_pipeline_depth"][
+            "series"][0]["value"] == 0
+
+
+# ------------------------------------------------ chains equal under chaos
+
+
+def test_pipelined_chaos_chains_equal_to_unpipelined():
+    """ISSUE acceptance: 4-node live cluster, pipelining + speculation +
+    batched intake ON, seeded chaos (drop + delay) — the settled prefix
+    must equal the unpipelined run's, and the speculation ledger must be
+    visible in telemetry_snapshot()."""
+    n = 4
+    plan = FaultPlan(seed=11, drop=0.10, delay=0.25, delay_s=0.05)
+
+    async def go(port, pipe):
+        agents = [PeerAgent(_cfg(i, n, port, secure_agg=True,
+                                 verification=True, fault_plan=plan,
+                                 pipeline=pipe, speculation=pipe,
+                                 batch_intake=pipe))
+                  for i in range(n)]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    agents_on, on = asyncio.run(go(26130, True))
+    _, off = asyncio.run(go(26150, False))
+    # both runs individually settle on one chain...
+    for results in (on, off):
+        equal, common, _ = chaos.chain_oracle(results)
+        assert equal and common >= 1, "cluster diverged under chaos"
+    # ...and the runs agree with EACH OTHER on the settled prefix (the
+    # oracle over the union compares the common prefix across all eight)
+    equal, common, real = chaos.chain_oracle(on + off)
+    assert equal, "pipelined run diverged from the unpipelined chains"
+    assert common >= 1 and real >= 1
+    # the speculation plane actually ran and is scrapeable
+    snaps = [a.telemetry_snapshot() for a in agents_on]
+    ready = sum(s["counters"].get("speculation_ready", 0) for s in snaps)
+    assert ready > 0, "no speculative step ever completed"
+    assert any("biscotti_speculation_hits" in s["metrics"] for s in snaps)
+    # phase-overlap accounting: the profiling table sees the rounds and
+    # the batched-intake settles
+    table = profile_round.collect_round_table(agents_on)
+    assert table["rounds"], "no rounds in the overlap table"
+    assert any(r.get("wall_s") is not None for r in table["rounds"])
+    assert table["crypto_batch_sizes"], "no batched settles recorded"
+
+
+# -------------------------------------------------- speculation rollback
+
+
+def test_fork_discards_speculative_step_and_counts_it():
+    """A fork landing on the speculated height must discard the
+    speculative products (never consume them) and surface the discard in
+    telemetry_snapshot() — the rollback half of speculation."""
+    cfg = _cfg(0, 5, 26170, pipeline=True, speculation=True)
+    agent = PeerAgent(cfg)
+    # pin the next-round role map: the speculation plane only precomputes
+    # for workers, and stake elections need not make node 0 one
+    agent._elect_role_map = lambda: R.RoleMap.build(
+        5, verifiers=[1], miners=[2])
+
+    async def go():
+        it0 = agent.iteration
+        blk1 = agent._empty_block()
+        agent._accept_block(blk1, gossip=False, minted=True)
+        assert agent._spec_task is not None, "speculation never kicked"
+        await agent._spec_task
+        assert agent._spec is not None \
+            and agent._spec["base"] == blk1.hash
+        # fork: a higher-quality block replaces blk1 at the same height
+        u = Update(source_id=3, iteration=it0,
+                   delta=np.zeros(0, np.float64),
+                   commitment=b"\xcd" * 32, accepted=True)
+        stake = dict(blk1.stake_map)
+        stake[3] = stake.get(3, 0) + cfg.stake_unit
+        blk2 = Block(data=BlockData(iteration=it0,
+                                    global_w=agent.chain.latest_gradient(),
+                                    deltas=[u]),
+                     prev_hash=blk1.prev_hash, stake_map=stake).seal()
+        agent._accept_block(blk2, gossip=False, minted=True)
+        assert agent.chain.latest_hash() == blk2.hash, "fork not adopted"
+        # the stale speculative step was discarded, not consumed
+        assert agent.counters.get("speculation_discard", 0) >= 1
+        snap = agent.telemetry_snapshot()
+        assert snap["counters"]["speculation_discard"] >= 1
+        series = snap["metrics"]["biscotti_speculation_discards"]["series"]
+        assert series[0]["value"] >= 1
+        # and a claim for the post-fork head refuses leftover products
+        assert await agent._claim_spec(agent.iteration) is None \
+            or agent._spec is None
+
+    asyncio.run(go())
+
+
+def test_claim_spec_mismatch_counts_discard():
+    cfg = _cfg(0, 5, 26190, pipeline=True, speculation=True)
+    agent = PeerAgent(cfg)
+    agent._spec = {"it": agent.iteration, "base": b"\x00" * 32,
+                   "delta": np.zeros(agent.trainer.num_params)}
+
+    async def go():
+        assert await agent._claim_spec(agent.iteration) is None
+
+    asyncio.run(go())
+    assert agent.counters.get("speculation_discard", 0) == 1
+    assert agent._spec is None
+
+
+# ---------------------------------------------- batched plain-mode intake
+
+
+def _mk_plain_updates(agent, it, count, bad_sid):
+    """`count` worker updates for the agent's commit key; `bad_sid`'s
+    commitment is for a DIFFERENT delta (poisoned)."""
+    rng = np.random.default_rng(7)
+    d = agent.trainer.num_params
+    out = []
+    for sid in range(count):
+        delta = rng.normal(size=d)
+        q = agent._quantize_np(delta)
+        if sid == bad_sid:
+            commitment = cm.commit_update(q + 3, agent.commit_key)
+        else:
+            commitment = cm.commit_update(q, agent.commit_key)
+        out.append(Update(source_id=sid, iteration=it, delta=delta,
+                          commitment=commitment))
+    return out
+
+
+def _run_plain_intake(batch_on: bool, port: int):
+    cfg = _cfg(0, 40, port, num_nodes=40, batch_intake=batch_on)
+    agent = PeerAgent(cfg)
+    agent.commit_key = cm.CommitKey.generate(agent.trainer.num_params)
+    agent.role_map = R.RoleMap.build(40, verifiers=[1], miners=[0])
+    it = agent.iteration
+    loop_updates = {}
+
+    async def go():
+        fut = asyncio.get_running_loop().create_future()
+        fut.set_result(set())
+        agent.round = RoundState(iteration=it, krum_decision=fut,
+                                 block_done=asyncio.Event())
+        updates = _mk_plain_updates(agent, it, 35, bad_sid=17)
+        loop_updates.update({u.source_id: u for u in updates})
+
+        async def submit(u):
+            meta, arrays = wire.pack_update(u)
+            meta["iteration"] = it
+            try:
+                await agent._h_register_update(meta, arrays)
+                return None
+            except RPCError as e:
+                return str(e)
+
+        return await asyncio.gather(*(submit(u) for u in updates))
+
+    outcomes = asyncio.run(go())
+    return agent, outcomes
+
+
+def test_batched_intake_bisection_matches_sequential():
+    """ISSUE acceptance: one poisoned commitment in a 35-update intake is
+    identified (bisection) and rejected EXACTLY as the sequential path
+    does — same accepted set, same rejected record, same error."""
+    agent_b, out_b = _run_plain_intake(batch_on=True, port=26210)
+    agent_s, out_s = _run_plain_intake(batch_on=False, port=26230)
+    for agent, outcomes in ((agent_b, out_b), (agent_s, out_s)):
+        st = agent.round
+        assert sorted(st.miner_updates) == [i for i in range(35) if i != 17]
+        assert sorted(st.miner_rejected) == [17]
+        assert sum(o is not None for o in outcomes) == 1
+    # the batched run answered every submitter identically
+    assert out_b == out_s
+    assert agent_b.counters.get("plain_batch_verified", 0) >= 1
+    assert "plain_batch_verified" not in agent_s.counters
+
+
+def test_find_bad_commitments_is_exactly_sequential_verdicts():
+    key = cm.CommitKey.generate(48, b"bisect-test")
+    rng = np.random.default_rng(0)
+    items = []
+    for i in range(35):
+        q = rng.integers(-10**5, 10**5, size=48, dtype=np.int64)
+        items.append((cm.commit_update(q, key), q))
+    assert cm.batch_verify_commitments(items, key)
+    items[11] = (items[11][0], items[11][1] + 1)
+    items[29] = (cm.commit_update(items[29][1] * 2, key), items[29][1])
+    assert not cm.batch_verify_commitments(items, key)
+    sequential = [i for i, (c, q) in enumerate(items)
+                  if not cm.verify_commitment(c, q, key)]
+    assert cm.find_bad_commitments(items, key) == sequential == [11, 29]
+
+
+# ------------------------------------------------- batched sig quorum
+
+
+def test_sig_quorum_batch_fast_path_and_fallback():
+    cfg = _cfg(0, 6, 26250, verification=True, num_verifiers=3)
+    agent = PeerAgent(cfg)
+    agent.role_map = R.RoleMap.build(6, verifiers=[1, 2, 3], miners=[0])
+    commitment = b"\xaa" * 32
+    msg = agent._sig_message(commitment, 0, 5)
+
+    def sig_of(vid):
+        seed = hashlib.sha256(f"schnorr-{cfg.seed}-{vid}".encode()).digest()
+        return cm.schnorr_sign(seed, msg)
+
+    # all-valid quorum: the batched RLC path accepts
+    assert agent._verify_sig_quorum(commitment, 0, 5, [1, 2, 3],
+                                    [sig_of(1), sig_of(2), sig_of(3)])
+    # one forged signature: batch fails, per-signature fallback still
+    # finds 2 of 3 valid (>= half) — accepted, as before the batching
+    assert agent._verify_sig_quorum(commitment, 0, 5, [1, 2, 3],
+                                    [sig_of(1), sig_of(2), b"\x00" * 64])
+    # below quorum: rejected
+    assert not agent._verify_sig_quorum(commitment, 0, 5, [1, 2, 3],
+                                        [sig_of(1), b"\x00" * 64,
+                                         b"\x00" * 64])
+    # duplicate-signer junk first, valid second: the pre-batch semantics
+    # (scan tolerates junk) must survive the batch dedup
+    assert agent._verify_sig_quorum(commitment, 0, 5, [1, 1, 2],
+                                    [b"\x00" * 64, sig_of(1), sig_of(2)])
+
+
+# --------------------------------------------- VSS intake accumulator
+
+
+def _vss_instances(n_workers, d=120, k=10, rows=5):
+    c = ss.num_chunks(d, k)
+    xs = [i - ss.SHARE_OFFSET for i in range(15)][:rows]
+    rng = np.random.default_rng(1)
+    out = []
+    for w in range(n_workers):
+        q = rng.integers(-1000, 1000, size=d, dtype=np.int64)
+        padded = np.zeros(c * k, np.int64)
+        padded[:d] = q
+        comms, blinds = cm.vss_commit_chunks(padded.reshape(c, k),
+                                             bytes([w + 1]) * 16, b"ctx")
+        br = cm.vss_blind_rows(blinds, xs)
+        sh = np.asarray(ss.make_shares(q, k, 15))[:rows]
+        out.append((comms, xs, sh, br))
+    return out, xs, c, k, rows
+
+
+def test_vss_accumulator_matches_oneshot_batch():
+    insts, xs, c, k, rows = _vss_instances(4)
+    acc = cm.VssIntakeBatch(rows, c, k)
+    for sid, (comms, _, sh, br) in enumerate(insts):
+        assert acc.add(sid, comms, sh, br)
+        if sid % 2:
+            assert acc.fold() == []  # mid-round waves fold incrementally
+    assert acc.verify(xs) is True
+    assert cm.vss_verify_multi(insts) is True
+    assert len(acc) == 4
+
+
+def test_vss_accumulator_flags_corruption_like_oneshot():
+    insts, xs, c, k, rows = _vss_instances(4)
+    acc = cm.VssIntakeBatch(rows, c, k)
+    for sid, (comms, _, sh, br) in enumerate(insts):
+        sh2 = sh.copy()
+        if sid == 2:
+            sh2[0, 0] += 1  # inconsistent share
+        assert acc.add(sid, comms, sh2, br)
+    assert acc.fold() == []
+    assert acc.verify(xs) is False
+    verdicts = {sid: cm.vss_verify_multi([(m[0], xs, m[1], m[2])])
+                for sid, m in acc.members().items()}
+    assert verdicts == {0: True, 1: True, 2: False, 3: True}
+
+
+def test_vss_accumulator_evicts_bad_grid_at_fold():
+    insts, xs, c, k, rows = _vss_instances(3)
+    acc = cm.VssIntakeBatch(rows, c, k)
+    assert acc.add(0, insts[0][0], insts[0][2], insts[0][3])
+    ugly = insts[1][0].copy()
+    ugly[0, 0, :] = 0xFF  # not a curve point
+    assert acc.add(9, ugly, insts[1][2], insts[1][3])
+    assert acc.fold() == [9]
+    assert sorted(acc.members()) == [0]
+    assert acc.verify(xs) is True  # the survivor still settles clean
+
+
+# ------------------------------------------------------- derivation caches
+
+
+def test_commit_key_derivation_memoized():
+    k1 = cm.CommitKey.generate(32, b"memo-test")
+    k2 = cm.CommitKey.generate(32, b"memo-test")
+    assert k1.points[5] is k2.points[5], "generate memo missed"
+    ser = k1.serialize()
+    d1 = cm.CommitKey.deserialize(ser)
+    d2 = cm.CommitKey.deserialize(ser)
+    assert d1.points[7] is d2.points[7], "deserialize memo missed"
+    # distinct labels stay distinct keys
+    other = cm.CommitKey.generate(32, b"memo-test-2")
+    assert other.points[0] != k1.points[0]
+
+
+def test_recovery_pinv_memo_roundtrips_exactly():
+    q = np.arange(-600, 600, dtype=np.int64)
+    d = len(q)
+    sh = np.asarray(ss.make_shares(q, 10, 20))
+    agg = np.asarray(ss.aggregate_shares(sh[None].repeat(3, axis=0)))
+    xs = np.asarray(ss.share_xs(20))
+    rec1 = ss.recover_update(agg, xs, d, 10, 4)
+    rec2 = ss.recover_update(agg, xs, d, 10, 4)  # cached pinv path
+    expect = 3 * q / 10.0**4
+    assert np.allclose(rec1, expect, atol=1e-9)
+    assert np.array_equal(rec1, rec2)
