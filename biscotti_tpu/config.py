@@ -204,6 +204,25 @@ class BiscottiConfig:
     # behavior: admit everything, park without bound).
     admission_plan: AdmissionPlan = field(default_factory=AdmissionPlan)
 
+    # --- membership plane (runtime/membership.py, docs/MEMBERSHIP.md) ---
+    # snapshot_bootstrap=True: a (re)joining peer catches up from a chain
+    # SNAPSHOT pulled over the chunked GetSnapshot RPC — genesis hash
+    # pinned, the sealed suffix's quorums verified — instead of replaying
+    # every block since genesis through the RegisterPeer reply. Default
+    # off = the seed join path.
+    snapshot_bootstrap: bool = False
+    # how many sealed blocks of suffix a GetSnapshot reply carries (plus
+    # the trust-anchor base block and genesis); chains at or below this
+    # height serve their full chain and the joiner adopts it normally
+    snapshot_tail: int = 8
+    # reshare=True arms the distributed resharing round: when the leader
+    # loses a miner mid-round (a membership epoch bump), surviving share
+    # holders re-deal their slices via GetReshareDeal — Shamir proactive
+    # resharing with homomorphically-updated Pedersen commitments — and
+    # the round's secure-agg recovery proceeds from the re-dealt shares
+    # where the seed protocol could only mint an empty block
+    reshare: bool = True
+
     # --- pipelined round engine (docs/RUNTIME.md §Pipelined rounds) ---
     # pipeline=True overlaps work across round boundaries: near-future
     # intake (iteration ≤ current + pipeline_depth) runs its
@@ -321,6 +340,12 @@ class BiscottiConfig:
         # an enabled admission plan with nonsensical caps must fail at
         # construction, not mid-round when the first frame is budgeted
         self.admission_plan.validate()
+        if not (0.0 <= self.fault_plan.churn < 1.0):
+            raise ValueError(
+                f"fault_plan.churn={self.fault_plan.churn} must be in "
+                "[0, 1): it is the membership fraction churned per window")
+        if self.snapshot_tail < 1:
+            raise ValueError("snapshot_tail must be >= 1")
 
     # ------------------------------------------------------------------ derived
 
@@ -464,6 +489,32 @@ class BiscottiConfig:
                        help="frame-storm replay factor: every outbound "
                             "frame is written 1+N times (deterministic "
                             "flooding peer for admission tests)")
+        p.add_argument("--fault-churn", type=float, default=FaultPlan.churn,
+                       help="fraction of the membership killed+restarted "
+                            "per churn window, seeded schedule (0.2 = "
+                            "the ISSUE's 20%% turnover); window-0 "
+                            "victims become late JOINERS")
+        p.add_argument("--fault-churn-period", type=int,
+                       default=FaultPlan.churn_period,
+                       help="rounds per churn window")
+        p.add_argument("--fault-churn-down", type=int,
+                       default=FaultPlan.churn_down,
+                       help="rounds a churned peer stays down before its "
+                            "scheduled restart")
+        p.add_argument("--snapshot-bootstrap", type=int,
+                       default=int(BiscottiConfig.snapshot_bootstrap),
+                       help="1: (re)joining peers catch up from a chain "
+                            "snapshot (GetSnapshot RPC) instead of "
+                            "replaying genesis (docs/MEMBERSHIP.md)")
+        p.add_argument("--snapshot-tail", type=int,
+                       default=BiscottiConfig.snapshot_tail,
+                       help="sealed suffix blocks a GetSnapshot reply "
+                            "carries")
+        p.add_argument("--reshare", type=int,
+                       default=int(BiscottiConfig.reshare),
+                       help="1: distributed Shamir resharing round when "
+                            "a miner is lost mid-round (0 = seed "
+                            "behavior, the round goes empty)")
         p.add_argument("--admission", type=int,
                        default=int(AdmissionPlan.enabled),
                        help="1 arms the overload-governance plane: "
@@ -595,6 +646,10 @@ class BiscottiConfig:
             wire_chunk_bytes=getattr(ns, "wire_chunk_bytes",
                                      cls.wire_chunk_bytes),
             wire_topk=getattr(ns, "wire_topk", cls.wire_topk),
+            snapshot_bootstrap=bool(getattr(ns, "snapshot_bootstrap",
+                                            cls.snapshot_bootstrap)),
+            snapshot_tail=getattr(ns, "snapshot_tail", cls.snapshot_tail),
+            reshare=bool(getattr(ns, "reshare", cls.reshare)),
             telemetry=bool(getattr(ns, "telemetry", cls.telemetry)),
             metrics_port=getattr(ns, "metrics_port", cls.metrics_port),
             recorder_ring=getattr(ns, "recorder_ring", cls.recorder_ring),
@@ -607,6 +662,11 @@ class BiscottiConfig:
                 duplicate=getattr(ns, "fault_dup", FaultPlan.duplicate),
                 reset=getattr(ns, "fault_reset", FaultPlan.reset),
                 flood=getattr(ns, "fault_flood", FaultPlan.flood),
+                churn=getattr(ns, "fault_churn", FaultPlan.churn),
+                churn_period=getattr(ns, "fault_churn_period",
+                                     FaultPlan.churn_period),
+                churn_down=getattr(ns, "fault_churn_down",
+                                   FaultPlan.churn_down),
             ),
             admission_plan=AdmissionPlan(
                 enabled=bool(getattr(ns, "admission",

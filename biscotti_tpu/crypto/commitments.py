@@ -991,6 +991,149 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
     return ed.point_equal(lhs, rhs)
 
 
+# ------------------------------------------------- proactive resharing
+#
+# Commitment algebra for the distributed resharing round
+# (ops/secretshare.reshare_*, docs/MEMBERSHIP.md). Pedersen commitments
+# are additively homomorphic in BOTH directions this plane needs:
+#
+#   * across workers — the commitment grid of an AGGREGATED row slice is
+#     the cell-wise point sum of the contributors' grids
+#     (sum_commitment_grids), with the aggregated blind the scalar sum
+#     of their blind rows (sum_blind_rows);
+#   * across coefficients — the commitment to a polynomial's value at x
+#     is Σⱼ xʲ·Cⱼ (commitment_eval_xy), with no new commitment needed.
+#
+# A holder re-dealing its row therefore commits its sub-share polynomial
+# with the CONSTANT blinding coefficient pinned to its own blind value
+# (reshare_commit_row), and every recipient checks, exactly:
+#
+#   sub_comms[c][0]  ==  Σⱼ x_oldʲ · orig_comms[c][j]
+#
+# — the sub-deal's claimed constant IS the original committed row value,
+# updated homomorphically, so verification across a resharing epoch
+# stays as exact as intake verification was (reshare_verify_deal).
+
+
+def sum_commitment_grids(grids: Sequence[np.ndarray]) -> Optional[np.ndarray]:
+    """Cell-wise point sum of [C, k, 64] affine commitment grids — the
+    commitment grid of the SUM of the committed polynomials. Returns
+    None if any cell fails to load (off-curve / non-canonical)."""
+    if not grids:
+        return None
+    c_chunks, k = grids[0].shape[0], grids[0].shape[1]
+    out = np.zeros((c_chunks, k, 64), np.uint8)
+    for ci in range(c_chunks):
+        for j in range(k):
+            acc = ed.IDENTITY
+            for g in grids:
+                p = _xy_to_point(bytes(np.ascontiguousarray(g[ci, j])))
+                if p is None:
+                    return None
+                acc = ed.point_add(acc, p)
+            x, y = ed.to_affine(acc)
+            out[ci, j, :32] = np.frombuffer(x.to_bytes(32, "little"),
+                                            np.uint8)
+            out[ci, j, 32:] = np.frombuffer(y.to_bytes(32, "little"),
+                                            np.uint8)
+    return out
+
+
+def sum_blind_rows(blind_rows: Sequence[np.ndarray]) -> List[List[int]]:
+    """Scalar sum (mod q) of [S, C, 32] blind-row tensors → [S][C] python
+    ints: the blinding values of an aggregated share slice, the companion
+    of sum_commitment_grids on the opening side."""
+    s, c = blind_rows[0].shape[0], blind_rows[0].shape[1]
+    out = [[0] * c for _ in range(s)]
+    for arr in blind_rows:
+        buf = np.ascontiguousarray(arr, np.uint8).tobytes()
+        for si in range(s):
+            for ci in range(c):
+                off = 32 * (si * c + ci)
+                out[si][ci] = (out[si][ci] + int.from_bytes(
+                    buf[off: off + 32], "little")) % _Q
+    return out
+
+
+def commitment_eval_xy(comms: np.ndarray, x: int) -> Optional[List[ed.Point]]:
+    """Homomorphic evaluation of every chunk's committed polynomial at
+    share point `x`: [C, k, 64] grid → one point per chunk,
+    Σⱼ xʲ·C_cj = commit(f_c(x), b_c(x)). Returns None when a cell fails
+    to load."""
+    c_chunks, k = comms.shape[0], comms.shape[1]
+    buf = np.ascontiguousarray(comms).tobytes()
+    scalars = []
+    xj = 1
+    for _ in range(k):
+        scalars.append(xj % _Q)
+        xj *= int(x)
+    out: List[ed.Point] = []
+    for ci in range(c_chunks):
+        pts = []
+        for j in range(k):
+            off = 64 * (ci * k + j)
+            p = _xy_to_point(buf[off: off + 64])
+            if p is None:
+                return None
+            pts.append(p)
+        out.append(msm(scalars, pts))
+    return out
+
+
+def reshare_commit_row(coeffs_row: np.ndarray, blind0: Sequence[int],
+                       seed: bytes,
+                       context: bytes) -> Tuple[np.ndarray, List[List[int]]]:
+    """Commit one re-dealt row's sub-share polynomials: [C, k] int64
+    coefficients (column 0 = the held row values,
+    ops/secretshare.reshare_coeffs) with the CONSTANT blinding
+    coefficient pinned to the holder's own blind values `blind0` ([C]
+    ints) — that pin is what makes the sub-deal homomorphically
+    verifiable against the original commitments. Higher blinding
+    coefficients come fresh from the XOF exactly like an intake commit.
+    Returns (comms uint8 [C, k, 64], blinds [C][k] ints)."""
+    coeffs_row = np.asarray(coeffs_row, np.int64)
+    c_chunks, k = coeffs_row.shape
+    raw = vss_blind_bytes(c_chunks * k, seed, context + b"|reshare")
+    blinds = _unpack_blinds(raw, c_chunks, k)
+    for ci in range(c_chunks):
+        blinds[ci][0] = int(blind0[ci]) % _Q
+    flat_a = [int(v) % _Q for v in coeffs_row.reshape(-1)]
+    flat_b = [blinds[ci][j] for ci in range(c_chunks) for j in range(k)]
+    rawc = batch_pedersen_commit_xy(flat_a, flat_b)
+    comms = np.frombuffer(rawc, dtype=np.uint8).reshape(
+        c_chunks, k, 64).copy()
+    return comms, blinds
+
+
+def reshare_verify_deal(orig_comms: np.ndarray, x_old: int,
+                        sub_comms: np.ndarray, xs_new: Sequence[int],
+                        sub_rows: np.ndarray,
+                        sub_blind_rows: np.ndarray) -> bool:
+    """Verify one holder's re-deal of the row it held at `x_old`:
+
+    1. BINDING — the sub-deal's constant commitments equal the
+       homomorphic evaluation of the ORIGINAL grid at x_old (per chunk):
+       the re-dealt secret is provably the row the holder was given, not
+       a substitute.
+    2. CONSISTENCY — every (sub-share, sub-blind) evaluation verifies
+       against the sub-deal grid (the standard batched VSS check).
+
+    `orig_comms` is the [C, k, 64] grid of the shared polynomial — for an
+    aggregated slice, sum_commitment_grids of the contributors' grids."""
+    ev = commitment_eval_xy(orig_comms, x_old)
+    if ev is None or sub_comms.shape != orig_comms.shape:
+        return False
+    buf = np.ascontiguousarray(sub_comms).tobytes()
+    k = sub_comms.shape[1]
+    for ci, expect in enumerate(ev):
+        p = _xy_to_point(buf[64 * ci * k: 64 * ci * k + 64])
+        if p is None or not ed.point_equal(p, expect):
+            return False
+    return vss_verify_multi([(sub_comms, list(xs_new),
+                              np.asarray(sub_rows, np.int64),
+                              np.asarray(sub_blind_rows, np.uint8))])
+
+
 class VssIntakeBatch:
     """Incremental round-intake VSS verification — the pipelined miner's
     half of `vss_verify_multi`.

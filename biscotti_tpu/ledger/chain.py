@@ -29,6 +29,18 @@ class ChainInvariantError(RuntimeError):
 
 
 class Blockchain:
+    # Snapshot-bootstrap support (docs/MEMBERSHIP.md): a late joiner
+    # adopting a chain SUFFIX holds [genesis] + blocks[pruned_before..head]
+    # — the heights in [0, pruned_before) are absent by design (the whole
+    # point of the snapshot is not fetching them). Class-level defaults so
+    # instances built via __new__ (checkpoint.load, the announce path)
+    # stay contiguous full chains with zero behavior change.
+    pruned_before: int = 0
+    # fork-choice weight CLAIMED for the pruned-away range (advisory, like
+    # the join path's have_weight — over/underclaiming only affects which
+    # chains this peer bothers adopting; adopted chains are verified)
+    pruned_weight: int = 0
+
     def __init__(self, num_params: int, num_nodes: int, default_stake: int = 10):
         self.blocks: List[Block] = [genesis_block(num_params, num_nodes, default_stake)]
 
@@ -42,7 +54,14 @@ class Blockchain:
         return self.blocks[-1]
 
     def get_block(self, iteration: int) -> Optional[Block]:
-        idx = iteration + 1
+        if self.pruned_before:
+            if iteration == -1:
+                return self.blocks[0]
+            if iteration < self.pruned_before:
+                return None  # pruned away: the snapshot's whole purpose
+            idx = iteration - self.pruned_before + 1
+        else:
+            idx = iteration + 1
         if 0 <= idx < len(self.blocks):
             return self.blocks[idx]
         return None
@@ -102,7 +121,10 @@ class Blockchain:
         if blk.iteration == self.latest.iteration and len(self.blocks) >= 2:
             if blk.hash != blk.compute_hash():
                 return False
-            prev = self.blocks[-2].hash
+            # the head's true parent hash: equals blocks[-2].hash on a
+            # contiguous chain (the append invariant), and stays correct
+            # when blocks[-2] is genesis across a pruned gap
+            prev = self.latest.prev_hash
             if self.block_quality(blk, prev) > self.block_quality(self.latest, prev):
                 self.blocks[-1] = blk
                 return True
@@ -112,9 +134,12 @@ class Blockchain:
         """The fork-choice comparison key: (weight, length), weight =
         non-empty block count. A chain is adopted over another iff its key
         is strictly greater — the single source of truth shared by
-        maybe_adopt and the join path's chain-omission gate."""
-        return (sum(1 for b in self.blocks if not b.is_empty()),
-                len(self.blocks))
+        maybe_adopt and the join path's chain-omission gate. A pruned
+        (snapshot-bootstrapped) chain counts its absent range via the
+        snapshot's advisory weight claim plus the range's known length."""
+        return (sum(1 for b in self.blocks if not b.is_empty())
+                + self.pruned_weight,
+                len(self.blocks) + self.pruned_before)
 
     def maybe_adopt(self, other: "Blockchain") -> bool:
         """Fork-choice adoption on (re)join (ref: main.go:1001-1013 adopts
@@ -146,34 +171,46 @@ class Blockchain:
         if not other.blocks or not self.blocks or \
                 other.blocks[0].hash != self.blocks[0].hash:
             return False  # different genesis — refuse before any O(n) work
-
-        def weight(blocks):
-            return sum(1 for b in blocks if not b.is_empty())
-
-        mine_key = self.adoption_key()
-        theirs_key = (weight(other.blocks), len(other.blocks))
-        if theirs_key <= mine_key:
+        if other.adoption_key() <= self.adoption_key():
             return False
         try:
             other.verify()
         except ChainInvariantError:
             return False
         self.blocks = copy.deepcopy(other.blocks)
+        self.pruned_before = other.pruned_before
+        self.pruned_weight = other.pruned_weight
         return True
 
     # ------------------------------------------------------------- oracle
 
     def dump(self) -> str:
         """Deterministic chain dump; byte-equality across peers is the
-        top-level integration oracle (ref: DistSys/localTest.sh:40-96)."""
-        return "\n".join(b.summary() for b in self.blocks)
+        top-level integration oracle (ref: DistSys/localTest.sh:40-96). A
+        pruned chain interleaves an explicit gap marker so a
+        snapshot-bootstrapped peer's dump is honest about what it never
+        held (the churn oracle compares per-height `iter=` lines and
+        skips the marker; runtime/membership.surviving_prefix_oracle)."""
+        lines = [self.blocks[0].summary()]
+        if self.pruned_before:
+            lines.append(f"pruned heights=0..{self.pruned_before - 1} "
+                         f"claimed_weight={self.pruned_weight}")
+        lines.extend(b.summary() for b in self.blocks[1:])
+        return "\n".join(lines)
 
     def verify(self) -> None:
-        """Full structural re-check: hashes, links, iteration numbering."""
+        """Full structural re-check: hashes, links, iteration numbering.
+        A pruned chain is allowed exactly ONE numbering/link gap — between
+        genesis and the snapshot suffix's first block (whose prev_hash
+        names a block deliberately not held); everything else is checked
+        identically."""
         for i, b in enumerate(self.blocks):
-            if b.iteration != i - 1:
+            expect_iter = (i - 1 if not self.pruned_before or i == 0
+                           else self.pruned_before + i - 1)
+            if b.iteration != expect_iter:
                 raise ChainInvariantError(f"block {i} has iteration {b.iteration}")
             if b.hash != b.compute_hash():
                 raise ChainInvariantError(f"block {i} hash mismatch")
-            if i > 0 and b.prev_hash != self.blocks[i - 1].hash:
+            if i > 0 and b.prev_hash != self.blocks[i - 1].hash \
+                    and not (self.pruned_before and i == 1):
                 raise ChainInvariantError(f"block {i} prev-hash mismatch")

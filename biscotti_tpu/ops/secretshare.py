@@ -45,6 +45,7 @@ module.
 
 from __future__ import annotations
 
+import hashlib
 import math
 
 import jax
@@ -232,6 +233,145 @@ def recover_update(agg_shares: jax.Array, xs: jax.Array, num_params: int,
     coeffs = recover_coeffs(agg_shares, xs, poly_size)
     flat = from_chunks(coeffs, num_params)  # numpy in → numpy out
     return np.asarray(flat).astype(np.float64) / (10.0 ** precision)
+
+
+# ------------------------------------------------- proactive resharing
+#
+# Dynamic membership (docs/MEMBERSHIP.md): when committee-relevant
+# membership changes mid-epoch, surviving share-holders RE-DEAL their
+# slices without any dealer — each holder sub-shares every held row as a
+# fresh Shamir instance whose constant term is the row value, and
+# recipients interpolate fresh shares of the same secret (two-level /
+# share-of-shares resharing). Recovery across the epoch needs only the
+# re-dealt material: ≥ poly_size surviving OLD rows, each re-dealt over
+# ≥ poly_size NEW points. Pedersen consistency is preserved exactly —
+# the sub-deal's constant-coefficient commitment must equal the
+# homomorphic evaluation of the ORIGINAL coefficient commitments at the
+# holder's old share point (crypto/commitments.commitment_eval_xy), so a
+# holder cannot re-deal a lie about its own row.
+#
+# Exactness bound, same contract as the rest of this module: sub-share
+# values are exact int64 and float64-recoverable, which caps the masking
+# coefficients at RESHARE_COEF_BOUND (|g(x)| ≤ |row| + k·bound·|x|^(k-1)
+# must stay well under 2^53). Hiding of a re-dealt row in transit is
+# therefore statistical-bounded, not perfect — categorically the same
+# trade the integer share pipeline itself makes (its share at x=0 IS a
+# raw coefficient); the BINDING side, which soundness rests on, is the
+# full-strength Pedersen check.
+
+RESHARE_COEF_BOUND = 1 << 22
+
+
+def reshare_coeffs(rows: np.ndarray, poly_size: int, seed: bytes,
+                   context: bytes) -> np.ndarray:
+    """Sub-share polynomial coefficients for every held row: [R, C] int64
+    row values → [R, C, k] int64 where [..., 0] is the row value and
+    higher coefficients are deterministic bounded-uniform masks drawn
+    from SHAKE-256(seed, context) — same seed + context ⇒ the identical
+    deal, so a resharing round is replayable like everything else."""
+    rows = np.asarray(rows, np.int64)
+    r, c = rows.shape
+    k = int(poly_size)
+    out = np.zeros((r, c, k), np.int64)
+    out[:, :, 0] = rows
+    if k > 1:
+        n = r * c * (k - 1)
+        raw = hashlib.shake_256(
+            seed + b"biscotti-reshare" + context).digest(8 * n)
+        mask = np.frombuffer(raw, dtype="<u8").astype(np.int64)
+        mask = np.abs(mask) % (2 * RESHARE_COEF_BOUND + 1)
+        out[:, :, 1:] = (mask - RESHARE_COEF_BOUND).reshape(r, c, k - 1)
+    return out
+
+
+def reshare_subshares(coeffs: np.ndarray, xs_new) -> np.ndarray:
+    """Evaluate every sub-share polynomial at the new share points:
+    [R, C, k] coefficients × [S'] points → [S', R, C] exact int64
+    (sub[s, r, c] = g_{r,c}(x'_s)). One einsum over the Vandermonde —
+    the share-generation matmul, batched across held rows."""
+    coeffs = np.asarray(coeffs, np.int64)
+    k = coeffs.shape[2]
+    v = _vandermonde_np(np.asarray(xs_new, np.int64), k)  # [S', k]
+    return np.einsum("sk,rck->src", v, coeffs)
+
+
+# Exact rational Vandermonde inverse, memoized per point set: the
+# masking coefficients push sub-share magnitudes past float64's exact-
+# integer range (2⁵³), so — unlike first-level recovery, whose values the
+# protocol keeps small — interpolation runs in EXACT python-int
+# arithmetic: inv(V) scaled to a common denominator D, one object-dtype
+# matmul, and a divisibility-checked //D at the end. Recovering the FULL
+# coefficient vector (not just the constant term) is what makes the
+# integrality check a corruption detector: an honest deal has int64
+# coefficients, while any single perturbed evaluation shifts the
+# interpolant by a Lagrange basis polynomial whose leading coefficient
+# 1/Π(x_j − x_m) cannot be ±1 over ≥ 3 distinct integer points — some
+# recovered coefficient goes non-integer and the deal is refused loudly.
+_vinv_cache: dict = {}
+
+
+def _vandermonde_inv_scaled(xs_key: tuple) -> tuple:
+    """(integer matrix M [k,k], common denominator D) with
+    inv(vandermonde(xs)) = M / D; row 0 of M/D is the Lagrange-at-zero
+    weight vector."""
+    got = _vinv_cache.get(xs_key)
+    if got is None:
+        from fractions import Fraction
+        from math import lcm
+
+        k = len(xs_key)
+        # Gauss-Jordan over exact rationals on [V | I]
+        aug = [[Fraction(int(x) ** p) for p in range(k)] +
+               [Fraction(int(i == j)) for j in range(k)]
+               for i, x in enumerate(xs_key)]
+        for col in range(k):
+            piv = next(i for i in range(col, k) if aug[i][col])
+            aug[col], aug[piv] = aug[piv], aug[col]
+            pv = aug[col][col]
+            aug[col] = [v / pv for v in aug[col]]
+            for i in range(k):
+                if i != col and aug[i][col]:
+                    f = aug[i][col]
+                    aug[i] = [a - f * b for a, b in zip(aug[i], aug[col])]
+        # right half now holds inv(V): inv(V)[p][j] = coefficient p of
+        # the Lagrange basis polynomial L_j
+        inv = [row[k:] for row in aug]
+        d = lcm(*(f.denominator for row in inv for f in row))
+        m = tuple(tuple(int(f * d) for f in row) for row in inv)
+        if len(_vinv_cache) >= 64:
+            _vinv_cache.clear()
+        _vinv_cache[xs_key] = got = (m, d)
+    return got
+
+
+def reshare_recover_rows(sub: np.ndarray, xs_new,
+                         poly_size: int = POLY_SIZE) -> np.ndarray:
+    """Interpolate every sub-share polynomial's constant term back out:
+    [S', R, C] sub-shares over S' ≥ poly_size distinct points → [R, C]
+    original row values, EXACT (rational interpolation over the first
+    poly_size points — each point's integrity is separately proven by
+    the sub-deal's VSS check, so recovery may use any k of them; the
+    full recovered coefficient vector must additionally be integral,
+    which refuses any singly-corrupted evaluation set loudly). This
+    is what a coordinator — or any ≥ poly_size of the NEW holders
+    pooling their rows — computes to reconstruct the re-dealt secret."""
+    sub = np.asarray(sub, np.int64)
+    s = sub.shape[0]
+    if s < poly_size:
+        raise ValueError(
+            f"{s} sub-share points cannot determine a degree-"
+            f"{poly_size - 1} sub-polynomial: resharing recovery needs "
+            f">= {poly_size} new holders")
+    xs = [int(x) for x in np.asarray(xs_new).reshape(-1)]
+    m, den = _vandermonde_inv_scaled(tuple(xs[:poly_size]))
+    r, c = sub.shape[1], sub.shape[2]
+    flat = sub[:poly_size].reshape(poly_size, r * c).astype(object)
+    coef = np.array(m, dtype=object) @ flat  # [k, r*c], scaled by den
+    if any(int(v) % den for v in coef.reshape(-1)):
+        raise ValueError("sub-shares are not evaluations of one integer "
+                         "polynomial (corrupt or mismatched deal)")
+    out = np.array([int(v) // den for v in coef[0]], dtype=np.int64)
+    return out.reshape(r, c)
 
 
 # ----------------------------------------------------- chunk-axis sharding

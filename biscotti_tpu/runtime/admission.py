@@ -73,6 +73,13 @@ _MSG_CLASS: Dict[str, str] = {
     "VerifyUpdateKRUM": UPDATE,
     "VerifyUpdateRONI": UPDATE,
     "RequestNoise": UPDATE,
+    # membership plane (docs/MEMBERSHIP.md): a snapshot reply is the
+    # biggest frame the protocol serves (a whole sealed chain suffix),
+    # and a reshare deal carries per-row commitment grids — both budget
+    # as bulk so join storms and reshare rounds cannot starve the
+    # round-critical update class
+    "GetSnapshot": BULK,
+    "GetReshareDeal": BULK,
     "AdvertiseBlock": CONTROL,
     "RegisterDecline": CONTROL,
     "GetUpdateList": CONTROL,

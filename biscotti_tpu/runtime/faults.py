@@ -52,6 +52,19 @@ class CircuitOpenError(ConnectionError):
     (eviction, gather fan-outs, _safe_call) handles it unchanged."""
 
 
+class ChurnExit(Exception):
+    """A peer's own churn schedule told it to die this round (the
+    `--fault-churn` self-kill, docs/MEMBERSHIP.md). Raised out of the
+    round loop and caught in PeerAgent.run() as a CLEAN early exit — no
+    crash dump, sockets released synchronously — so an external launcher
+    (tools/chaos --churn, runtime/membership.ChurnRunner, a k8s restart
+    policy) can relaunch the process at the scheduled restart round."""
+
+    def __init__(self, round_: int):
+        super().__init__(f"churn schedule kill at round {round_}")
+        self.round = round_
+
+
 @dataclass(frozen=True)
 class FaultAction:
     """One frame's fate. Precedence when several faults draw true:
@@ -94,6 +107,23 @@ _BENIGN = FaultAction()
 
 
 @dataclass(frozen=True)
+class ChurnEvent:
+    """One scheduled membership change: at the start of `round`, `node` is
+    killed / restarted / first launched. Emitted by FaultPlan.churn_schedule
+    — a pure function of the seed, so any churn run's exact join/leave
+    timeline replays from the flags alone (docs/MEMBERSHIP.md)."""
+
+    round: int
+    node: int
+    kind: str  # KILL | RESTART | JOIN
+
+
+KILL = "kill"
+RESTART = "restart"
+JOIN = "join"
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Seeded link-fault configuration (surfaced as cfg.fault_plan).
 
@@ -122,11 +152,80 @@ class FaultPlan:
     # to every frame (no draw needed: replay count is the knob), except
     # frames that reset or drop first.
     flood: int = 0
+    # churn: fraction of the membership killed-and-restarted per
+    # `churn_period` rounds (0 disables). The schedule — which node goes
+    # down at which round, when it comes back, and which nodes JOIN late
+    # instead of launching at genesis — is a pure function of the seed
+    # (churn_schedule below), so a churn run is replayable exactly like a
+    # drop/delay/flood run. Node 0 is never churned: it is the anchor the
+    # oracle (and a real deployment's bootstrap list) measures against.
+    churn: float = 0.0
+    churn_period: int = 10  # rounds per churn window (ISSUE: 20% per 10)
+    churn_down: int = 3     # rounds a killed peer stays down
+    # membership-timeline seed override (-1: use `seed`). Lets a churn
+    # ablation vary the join/leave schedule while the frame-fault
+    # schedule (drop/delay/dup/reset/flood, keyed on `seed`) stays
+    # fixed — chaos `--churn-seed` rides this, never a plan reseed.
+    churn_seed: int = -1
 
     @property
     def enabled(self) -> bool:
+        """Frame-level injection armed? (Churn is NOT a frame fault: it is
+        consumed by the launch harness / the peer's own round loop, so a
+        churn-only plan does not pay the per-frame draw.)"""
         return (self.drop > 0.0 or self.delay > 0.0 or self.duplicate > 0.0
                 or self.reset > 0.0 or self.flood > 0)
+
+    @property
+    def churn_enabled(self) -> bool:
+        return self.churn > 0.0
+
+    def churn_schedule(self, num_nodes: int,
+                       max_rounds: int) -> List[ChurnEvent]:
+        """Deterministic membership timeline: per `churn_period` window,
+        ~`churn`·num_nodes victims are drawn by seeded hash; each victim
+        gets a KILL at a hashed offset inside the window and a RESTART
+        `churn_down` rounds later (when that still fits the run). A
+        window-0 victim instead becomes a late JOINER: it is not launched
+        at genesis and JOINs at its drawn round — so one knob exercises
+        join, leave, AND rejoin. Events are sorted by (round, node); node
+        0 is exempt (the anchor). Same (seed, churn, period, down,
+        num_nodes, max_rounds) ⇒ the identical list, always."""
+        if not self.churn_enabled or num_nodes <= 1 or max_rounds <= 0:
+            return []
+        seed = self.seed if self.churn_seed < 0 else self.churn_seed
+        period = max(1, int(self.churn_period))
+        down = max(1, int(self.churn_down))
+        events: List[ChurnEvent] = []
+        for w in range(-(-max_rounds // period)):
+            start = w * period
+            for node in range(1, num_nodes):
+                h = hashlib.sha256(
+                    f"biscotti-churn|{seed}|{node}|{w}".encode()
+                ).digest()
+                u = int.from_bytes(h[:6], "big") / float(1 << 48)
+                if u >= self.churn:
+                    continue
+                # drawn offset keeps the kill early enough in the window
+                # that the restart (kill + down) lands inside the run for
+                # every full window
+                span = max(1, period - down)
+                at = start + int.from_bytes(h[6:12], "big") % span
+                if at >= max_rounds:
+                    continue
+                if w == 0:
+                    # late joiner: skip genesis launch, join at the drawn
+                    # round (at=0 degenerates to a genesis launch — skip)
+                    if at > 0:
+                        events.append(ChurnEvent(round=at, node=node,
+                                                 kind=JOIN))
+                    continue
+                events.append(ChurnEvent(round=at, node=node, kind=KILL))
+                if at + down < max_rounds:
+                    events.append(ChurnEvent(round=at + down, node=node,
+                                             kind=RESTART))
+        events.sort(key=lambda e: (e.round, e.node, e.kind))
+        return events
 
     def action(self, src: int, dst: int, msg_type: str,
                attempt: int = 0, seq: int = 0) -> FaultAction:

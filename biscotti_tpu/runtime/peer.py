@@ -43,7 +43,7 @@ from biscotti_tpu.crypto import commitments as cm
 from biscotti_tpu.crypto.vrf import VRFKey
 from biscotti_tpu.data import datasets as ds
 from biscotti_tpu.ledger.block import Block, BlockData, Update
-from biscotti_tpu.ledger.chain import Blockchain
+from biscotti_tpu.ledger.chain import Blockchain, ChainInvariantError
 from biscotti_tpu.models.trainer import Trainer
 from biscotti_tpu.ops import secretshare as ss
 from biscotti_tpu.parallel import roles as R
@@ -380,6 +380,22 @@ class PeerAgent:
         # forged block that claims the same hash. Insertion-ordered dict =
         # LRU eviction of the stalest entry.
         self._quorum_ok_hashes: Dict[bytes, None] = {}
+        # membership plane (docs/MEMBERSHIP.md): the epoch counts this
+        # peer's OBSERVED membership transitions — a peer quarantined
+        # (left), a quarantined peer rehabilitated or a new hello from a
+        # non-alive id (joined), a resharing round run. Local by design
+        # (membership in a P2P system is a per-observer view); the gauge
+        # + join/leave counters make churn scrapeable mid-run
+        self.membership_epoch = 0
+        # rounds at which OUR OWN seeded churn schedule kills this peer
+        # (--fault-churn; the in-process ChurnRunner instead kills from
+        # the outside, which also covers hard-crash semantics)
+        self._churn_kills: frozenset = frozenset()
+        if cfg.fault_plan.churn_enabled:
+            self._churn_kills = frozenset(
+                e.round for e in cfg.fault_plan.churn_schedule(
+                    cfg.num_nodes, cfg.max_iterations)
+                if e.node == self.id and e.kind == faults.KILL)
 
     # ------------------------------------------------------------ utilities
 
@@ -441,6 +457,11 @@ class PeerAgent:
         reg.gauge("biscotti_speculation_discards",
                   "speculative worker steps discarded on fork/mismatch").set(
             self.counters.get("speculation_discard", 0))
+        # membership plane (docs/MEMBERSHIP.md): this peer's view of who
+        # is in, and how many times that view has changed
+        reg.gauge("biscotti_membership_epoch",
+                  "observed membership transitions (join/leave/reshare)"
+                  ).set(self.membership_epoch)
 
     def telemetry_snapshot(self) -> Dict:
         """THE public observability readout — one structured dict serving
@@ -466,6 +487,12 @@ class PeerAgent:
             # acceptance assertions (bounded peaks, nonzero sheds on
             # honest peers) read THIS, not private controller state
             "admission": self.admission.snapshot(),
+            # membership plane (docs/MEMBERSHIP.md): epoch + current
+            # alive view — the obs CLI's membership column and the churn
+            # harness assertions read this
+            "membership": {"epoch": self.membership_epoch,
+                           "alive": len(self.alive),
+                           "pruned_before": self.chain.pruned_before},
             # the recorder may be real even with telemetry disabled (an
             # explicit spill path keeps the event log alive) — report
             # whatever it actually holds
@@ -623,16 +650,31 @@ class PeerAgent:
         way (a still-busy peer re-marks itself on the next busy reply)."""
         return self._busy_peers.get(pid) == self.iteration
 
+    def _bump_epoch(self, change: str, peer: Optional[int] = None) -> None:
+        """One observed membership transition: epoch++, traced + counted
+        (`member_join` / `member_leave` / `reshare_round`) so churn is
+        visible on every scrape surface (docs/MEMBERSHIP.md)."""
+        self.membership_epoch += 1
+        self._trace(f"member_{change}" if change in ("join", "leave")
+                    else change,
+                    peer=peer, epoch=self.membership_epoch)
+
     def _record_peer_ok(self, peer_id: int) -> None:
         """One RPC toward `peer_id` proved the transport healthy: reset its
         failure streak and, if the breaker was tripped, close it."""
         if self.health.record_success(peer_id):
             self._trace("breaker_close", peer=peer_id)
+            if peer_id not in self.alive:
+                # rejoined the live set via OUR outbound probe (no inbound
+                # frame announced it first — the inbound seam in _handle
+                # owns that case, so one rejoin is never counted twice)
+                self._bump_epoch("join", peer_id)
         self.alive.add(peer_id)
 
     def _record_peer_fail(self, peer_id: int) -> None:
         if self.health.record_failure(peer_id):
             self._trace("breaker_open", peer=peer_id)
+            self._bump_epoch("leave", peer_id)
 
     async def _call(self, peer_id: int, msg_type: str, meta=None, arrays=None,
                     timeout: Optional[float] = None,
@@ -823,6 +865,14 @@ class PeerAgent:
             try:
                 src = int(src)
                 if src in self.peers:
+                    if src not in self.alive and src != self.id:
+                        # first frame from outside our live view: a late
+                        # joiner's hello, a restart, or an evicted peer
+                        # resurfacing — a membership transition, observed
+                        # at the earliest possible point (this seam runs
+                        # before any handler, so the hello-path check in
+                        # _h_register_peer would always see it alive)
+                        self._bump_epoch("join", src)
                     self.alive.add(src)
                     # inbound traffic is liveness evidence for the THEM→US
                     # path only: it expires a tripped breaker's cooldown so
@@ -847,6 +897,8 @@ class PeerAgent:
             "VerifyUpdateRONI": self._h_verify_update,
             "GetUpdateList": self._h_get_update_list,
             "GetMinerPart": self._h_get_miner_part,
+            "GetSnapshot": self._h_get_snapshot,
+            "GetReshareDeal": self._h_get_reshare_deal,
             "Metrics": self._h_metrics,
         }
         h = dispatch.get(msg_type)
@@ -928,7 +980,7 @@ class PeerAgent:
             self._addr_to_pid[self.peers[pid]] = pid
             self.pool.avoid_local_ports = frozenset(
                 p for _, p in self.peers.values())
-        self.alive.add(pid)
+        self.alive.add(pid)  # join transitions bump in _handle's seam
         # wire-plane negotiation: record the caller's codec capability
         # set (absent in a legacy hello → it stays raw64-only) and
         # advertise ours in the reply, so both ends of a first contact
@@ -942,8 +994,21 @@ class PeerAgent:
         # refused to adopt anyway; the adopted chain itself is verified.
         caller_key = (int(meta.get("have_weight", 0)),
                       int(meta.get("have_blocks", 0)))
-        if self.chain.adoption_key() <= caller_key:
-            return {"chain_omitted": True, "codecs": sorted(self.caps)}, {}
+        # `no_chain`: a snapshot-bootstrapping joiner's hello — it will
+        # pull a sealed suffix via GetSnapshot instead, so replying with
+        # the full chain here would silently re-pay exactly the genesis
+        # replay the snapshot path exists to avoid. A PRUNED server also
+        # omits: its gap-containing chain decodes as a contiguous
+        # candidate the receiver's quorum gate is guaranteed to refuse,
+        # so shipping it is pure wasted bulk — the caller should pull
+        # GetSnapshot (clusters mixing snapshot_bootstrap=0 joiners with
+        # all-pruned peers have no announce-path catch-up by design;
+        # docs/MEMBERSHIP.md §snapshot).
+        if meta.get("no_chain") or self.chain.pruned_before \
+                or self.chain.adoption_key() <= caller_key:
+            return {"chain_omitted": True,
+                    "snapshot_available": bool(self.chain.pruned_before),
+                    "codecs": sorted(self.caps)}, {}
         cmeta, carrays = wire.pack_chain(self.chain.blocks)
         cmeta["codecs"] = sorted(self.caps)
         return cmeta, carrays
@@ -997,6 +1062,212 @@ class PeerAgent:
         if blk is None:
             raise RPCError(f"no block at iteration {it}")
         return wire.pack_block(blk)
+
+    # ----------------------------------------------- membership: snapshot
+
+    async def _h_get_snapshot(self, meta, arrays):
+        """Serve a chain SNAPSHOT to a bootstrapping joiner
+        (docs/MEMBERSHIP.md): genesis + the last `snapshot_tail`+1 sealed
+        blocks — the +1 is the trust-anchor base whose stake map seeds
+        the suffix's quorum verification — plus an advisory weight claim
+        for the pruned-away range. Bulk-classed at admission, chunked by
+        the wire plane like any oversized reply; read-only and safe for
+        any caller (the chain is public gossip either way). The joiner
+        names the tail it wants (its own snapshot_tail); absent, the
+        server's policy applies — over-asking merely degrades toward the
+        full chain RegisterPeer would have served anyway."""
+        chain = self.chain
+        tail = max(1, int(meta.get("tail", 0) or 0)
+                   or self.cfg.snapshot_tail)
+        suffix = chain.blocks[1:]
+        dropped: List[Block] = []
+        if len(suffix) > tail + 1:
+            dropped = suffix[:-(tail + 1)]
+            suffix = suffix[-(tail + 1):]
+        pruned_weight = (chain.pruned_weight
+                         + sum(1 for b in dropped if not b.is_empty()))
+        cmeta, carrays = wire.pack_chain([chain.blocks[0]] + suffix)
+        cmeta["snapshot"] = {
+            "pruned_weight": pruned_weight,
+            "base_height": suffix[0].iteration if suffix else -1,
+        }
+        self._trace("snapshot_served",
+                    base=cmeta["snapshot"]["base_height"],
+                    blocks=len(suffix))
+        return cmeta, carrays
+
+    async def _snapshot_bootstrap(self) -> bool:
+        """Joiner half of the snapshot handshake: pull GetSnapshot from
+        peers (seeded-random order) until one validated snapshot adopts.
+        The preceding hello carried `no_chain`, so NO pre-snapshot block
+        ever crosses the wire for this peer — asserted by the wire byte
+        accounting (GetSnapshot.reply vs GetBlock.reply) in the
+        acceptance test.
+
+        The suffix's quorums verify against the BASE block's own carried
+        stake map, so a lone Byzantine donor could otherwise fabricate
+        base + committee + quorums wholesale: before adopting, the base
+        block's hash is corroborated by an INDEPENDENT peer (one
+        GetBlock at the base height — a single block, not history).
+        Capture now needs the donor AND the sampled corroborator to
+        collude; clusters with fewer than two other peers have nobody to
+        cross-check against and skip the step (genesis replay via the
+        announce path remains the fallback either way)."""
+        order = sorted(p for p in self.peers if p != self.id)
+        self._rng.shuffle(order)
+        for pid in order:
+            try:
+                rmeta, rarrays = await self._call(
+                    pid, "GetSnapshot",
+                    {"source_id": self.id,
+                     "tail": self.cfg.snapshot_tail,
+                     **self._reply_codec_meta(pid)})
+            except Exception:
+                continue
+            try:
+                blocks = wire.unpack_chain(rmeta, rarrays)
+            except Exception:
+                # a malformed reply must cost the DONOR its turn, never
+                # crash the joiner's run()
+                self._trace("snapshot_refused", reason="undecodable",
+                            peer=pid)
+                continue
+            claim = int((rmeta.get("snapshot") or {})
+                        .get("pruned_weight", 0) or 0)
+            base = blocks[1].iteration if len(blocks) >= 2 else -1
+            if base > 0 and len(order) >= 2:
+                ok = await self._corroborate_base(blocks[1], pid, order)
+                if not ok:
+                    self._trace("snapshot_refused",
+                                reason="base_uncorroborated", peer=pid)
+                    continue
+            # validation + adoption run ON the event loop: the suffix is
+            # at most snapshot_tail+1 blocks (bounded work), and the
+            # chain mutation must never race the live RPC handlers that
+            # read self.chain between awaits
+            if self._adopt_snapshot(blocks, claim, pid):
+                return True
+        return False
+
+    async def _corroborate_base(self, base: Block, donor: int,
+                                order: List[int]) -> bool:
+        """Ask peers OTHER than the snapshot's donor for the block at the
+        base height and compare hashes. The first peer that answers
+        decides; peers that are unreachable or pruned below the base are
+        skipped. Returns False when the answer disagrees (fork or
+        fabrication) or nobody could answer."""
+        for other in order:
+            if other == donor:
+                continue
+            try:
+                bmeta, barrays = await self._call(
+                    other, "GetBlock",
+                    {"iteration": int(base.iteration),
+                     "source_id": self.id,
+                     **self._reply_codec_meta(other)},
+                    timeout=self.timeouts.rpc_s)
+            except Exception:
+                continue  # unreachable / pruned: ask the next peer
+            try:
+                blk = wire.unpack_block(bmeta, barrays)
+            except Exception:
+                continue  # undecodable corroborator: ask the next peer
+            return blk.hash == base.hash
+        return False
+
+    def _adopt_candidate(self, blocks: List[Block],
+                         source: Optional[int] = None,
+                         quorums_ok: Optional[bool] = None) -> bool:
+        """Full-chain adoption with TRACED refusal reasons — the one gate
+        every chain offered to a (re)joining peer passes through
+        (announce replies, contiguous snapshots): genesis hash pinned,
+        fork-choice weight, quorum authentication, then maybe_adopt's
+        structural verify. Refusals land in the flight recorder as
+        `chain_refused{reason=…}` so a rejoin that kept its old history
+        is diagnosable from a scrape, not a debugger."""
+        if not blocks:
+            return False
+        if blocks[0].hash != self.chain.blocks[0].hash:
+            self._trace("chain_refused", reason="genesis_mismatch",
+                        peer=source)
+            return False
+        other = Blockchain.__new__(Blockchain)
+        other.blocks = blocks
+        if other.adoption_key() <= self.chain.adoption_key():
+            self._trace("chain_refused", reason="not_heavier", peer=source)
+            return False
+        # `quorums_ok` lets an async caller precompute the expensive
+        # batched-signature sweep in a worker thread (read-only, so
+        # thread-safe) while THIS method — which mutates self.chain —
+        # always runs on the event loop, never racing the live handlers
+        if (self._chain_quorums_ok(blocks)
+                if quorums_ok is None else quorums_ok) is not True:
+            self._trace("chain_refused", reason="quorum_unauthenticated",
+                        peer=source)
+            return False
+        return self.chain.maybe_adopt(other)
+
+    def _adopt_snapshot(self, blocks: List[Block], pruned_weight: int,
+                        source: Optional[int] = None) -> bool:
+        """Validate + adopt one GetSnapshot reply. Same refusal logic as
+        a checkpoint restore / live adoption, extended to the sealed
+        suffix: the genesis hash must be OURS (a foreign cluster's
+        snapshot is refused outright), the suffix must be structurally
+        sealed (hashes + links), and every block above the trust-anchor
+        base must carry verifier quorums valid under the committee its
+        carried parent state elects. The base block itself is the
+        snapshot's trust anchor — unverifiable without the pruned
+        history by construction; its integrity is pinned by the quorums
+        sealed on top of it (docs/MEMBERSHIP.md §trust-model)."""
+        if len(blocks) < 2 or blocks[0].iteration != -1:
+            self._trace("snapshot_refused", reason="malformed", peer=source)
+            return False
+        if blocks[0].hash != self.chain.blocks[0].hash:
+            self._trace("snapshot_refused", reason="genesis_mismatch",
+                        peer=source)
+            return False
+        base = blocks[1].iteration
+        if base <= 0:
+            # contiguous from genesis (short chain): ordinary adoption —
+            # full quorum verification, no trust anchor involved
+            if self._adopt_candidate(blocks, source):
+                self._trace("snapshot_adopted", base=0,
+                            height=self.chain.latest.iteration)
+                return True
+            return False
+        cand = Blockchain.__new__(Blockchain)
+        cand.blocks = blocks
+        cand.pruned_before = base
+        # the weight claim is advisory but STICKY (it enters our own
+        # adoption_key forever): clamp it to the pruned range's length —
+        # one non-empty block per pruned height is the physical maximum —
+        # so a Byzantine donor's pruned_weight=10**9 cannot make every
+        # future honest chain offer lose fork choice as "not_heavier"
+        cand.pruned_weight = max(0, min(int(pruned_weight), base))
+        try:
+            cand.verify()
+        except ChainInvariantError as e:
+            self._trace("snapshot_refused", reason=f"structure: {e}",
+                        peer=source)
+            return False
+        for i in range(2, len(blocks)):
+            if not self._block_quorums_ok(blocks[i],
+                                          blocks[i - 1].stake_map,
+                                          blocks[i - 1].hash):
+                self._trace("snapshot_refused",
+                            reason="quorum_unauthenticated",
+                            height=blocks[i].iteration, peer=source)
+                return False
+        if cand.adoption_key() <= self.chain.adoption_key():
+            self._trace("snapshot_refused", reason="not_heavier",
+                        peer=source)
+            return False
+        self.chain.blocks = blocks
+        self.chain.pruned_before = base
+        self.chain.pruned_weight = cand.pruned_weight
+        self._trace("snapshot_adopted", base=base,
+                    height=self.chain.latest.iteration)
+        return True
 
     def _accept_block(self, blk: Block, gossip: bool,
                       minted: bool = False) -> None:
@@ -1591,12 +1862,19 @@ class PeerAgent:
         # block either way
         return False
 
-    def _chain_quorums_ok(self, blocks: List[Block]) -> bool:
+    def _chain_quorums_ok(self, blocks: List[Block],
+                          pruned_before: int = 0) -> bool:
         """Authenticate every non-empty block of a CANDIDATE chain against
         the committees the chain itself elects (parent stake map + parent
         hash). Run before maybe_adopt: without it, chain weight — and
-        therefore fork choice — would be forgeable by anyone."""
-        for i in range(1, len(blocks)):
+        therefore fork choice — would be forgeable by anyone. A PRUNED
+        chain (pruned_before > 0, e.g. a snapshot-bootstrapped peer's own
+        checkpoint on restore) starts the check ABOVE the trust-anchor
+        base: blocks[1] sits across the gap, so its quorums cannot be
+        verified against genesis — same trust model as _adopt_snapshot,
+        which sealed that base when the chain was first adopted."""
+        start = 2 if pruned_before else 1
+        for i in range(start, len(blocks)):
             if not self._block_quorums_ok(blocks[i], blocks[i - 1].stake_map,
                                           blocks[i - 1].hash):
                 self._trace("candidate_chain_rejected",
@@ -2001,6 +2279,204 @@ class PeerAgent:
         agg = np.asarray(ss.aggregate_shares(stack))
         return {"nodes": nodes}, {"agg_rows": agg}
 
+    # ---------------------------------------------- membership: resharing
+
+    def _reshare_context(self, it: int) -> bytes:
+        """Domain-separated deal context: binds every sub-deal to (this
+        chain head, this round) so deals — like intake commitments —
+        can never be replayed across rounds or forks."""
+        return (self.chain.latest_hash()
+                + int(it).to_bytes(8, "little") + b"|reshare")
+
+    def _build_reshare_deal(self, st: RoundState, nodes: List[int],
+                            xs_new: List[int], it: int) -> Dict[str, np.ndarray]:
+        """Holder half of the distributed resharing round
+        (docs/MEMBERSHIP.md §resharing): sub-share every row of OUR
+        aggregated slice over `xs_new` as a fresh Shamir instance whose
+        constant term is the row value, commit each sub-polynomial with
+        the constant blinding coefficient pinned to our aggregated blind
+        (crypto/commitments.reshare_commit_row) — that pin is what lets
+        any recipient verify the deal homomorphically against the
+        ORIGINAL workers' commitments, no dealer anywhere. Runs off the
+        event loop (O(R·C·k) fixed-base commits)."""
+        stack = np.stack([st.miner_shares[n] for n in nodes])
+        agg_rows = np.asarray(ss.aggregate_shares(stack))  # [R, C]
+        agg_blinds = cm.sum_blind_rows(
+            [st.miner_vss_records[n][1] for n in nodes])   # [R][C] ints
+        ctx = self._reshare_context(it)
+        coeffs = ss.reshare_coeffs(agg_rows, self.cfg.poly_size,
+                                   self.schnorr_seed, ctx)
+        sub = ss.reshare_subshares(coeffs, xs_new)          # [S', R, C]
+        r_rows = agg_rows.shape[0]
+        sub_comms = np.zeros((r_rows,) + (coeffs.shape[1],
+                                          self.cfg.poly_size, 64), np.uint8)
+        sub_blinds = np.zeros((r_rows, len(xs_new), coeffs.shape[1], 32),
+                              np.uint8)
+        for r in range(r_rows):
+            # per-row context: reusing one blind XOF stream across rows
+            # would let an observer difference two rows' commitments and
+            # cancel the H term (the Feldman leak the blinds exist for)
+            comms_r, blinds_r = cm.reshare_commit_row(
+                coeffs[r], agg_blinds[r], self.schnorr_seed,
+                ctx + r.to_bytes(4, "little"))
+            sub_comms[r] = comms_r
+            sub_blinds[r] = cm.vss_blind_rows(blinds_r, xs_new)
+        return {"sub_rows": sub, "sub_comms": sub_comms,
+                "sub_blinds": sub_blinds}
+
+    async def _h_get_reshare_deal(self, meta, arrays):
+        """Surviving share-holder serves its re-deal to the resharing
+        coordinator (the round leader) after a membership epoch bump.
+        Release conditions mirror GetMinerPart exactly — leader-signed
+        request (the signature covers the node set AND the new point
+        layout), privacy floor, at most ONE aggregation set per round
+        (shared `served_part` guard: a leader cannot pull a reshare deal
+        for one subset and a share slice for another and difference
+        them), aggregation-boundary VSS re-check."""
+        it = int(meta["iteration"])
+        st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
+        nodes = [int(x) for x in meta["nodes"]]
+        xs_new = [int(x) for x in meta["xs_new"]]
+        # the length prefix pins the nodes/xs_new boundary inside the
+        # signed flat list — without it, sign(n + xs) for one split is
+        # byte-identical to a shifted split of the same ints
+        self._check_leader_request("reshare", it,
+                                   [len(nodes)] + nodes + xs_new, meta)
+        await self._verify_intake(st)
+        if len(set(nodes)) != len(nodes):
+            raise RPCError("duplicate nodes in aggregation set")
+        if len(set(xs_new)) != len(xs_new) or \
+                len(xs_new) < self.cfg.poly_size:
+            raise RPCError("reshare point layout degenerate")
+        if any(abs(x) > 4 * self.cfg.total_shares for x in xs_new):
+            # hostile far-out points would blow the exact-int64 bound of
+            # the sub-share evaluation (ops/secretshare.RESHARE_COEF_BOUND)
+            raise RPCError("reshare points outside the exactness bound")
+        if not all(n in st.miner_shares for n in nodes):
+            raise RPCError("missing shares for requested nodes")
+        if len(nodes) < min(2, len(st.miner_shares)):
+            raise RPCError("aggregation set below privacy floor")
+        if st.served_part is not None and st.served_part != sorted(nodes):
+            raise RPCError("a different aggregation set was already served")
+        if not await self._ensure_subset_consistent(st, nodes):
+            raise RPCError("aggregation set fails VSS re-check")
+        if not all(n in st.miner_vss_records for n in nodes):
+            # plain hash-commitment mode (keyless) carries no VSS records
+            # to re-deal against — resharing is a secure-agg capability
+            raise RPCError("no VSS records to reshare")
+        st.served_part = sorted(nodes)
+        with self.tele.span("reshare_deal", it=it):
+            deal = await asyncio.to_thread(self._build_reshare_deal, st,
+                                           nodes, xs_new, it)
+        self._trace("reshare_deal_served", rows=int(deal["sub_rows"].shape[1]))
+        return {"nodes": nodes}, deal
+
+    def _verify_reshare_deal(self, grid_sum: np.ndarray, xs_old: List[int],
+                             xs_new: List[int],
+                             deal: Dict) -> Optional[np.ndarray]:
+        """Coordinator-side check of one holder's re-deal: every row's
+        sub-commitments must equal the homomorphic evaluation of the
+        summed ORIGINAL commitments at the holder's old point, and every
+        sub-share must verify against its sub-commitments
+        (crypto/commitments.reshare_verify_deal). Returns the holder's
+        reconstructed row values [R, C] (the exact material the seed
+        protocol would have pulled via GetMinerPart) or None."""
+        sub_rows = np.asarray(deal["sub_rows"], np.int64)
+        sub_comms = np.asarray(deal["sub_comms"], np.uint8)
+        sub_blinds = np.asarray(deal["sub_blinds"], np.uint8)
+        r_rows = len(xs_old)
+        k = self.cfg.poly_size
+        c_chunks = grid_sum.shape[0]
+        if (sub_rows.shape != (len(xs_new), r_rows, c_chunks)
+                or sub_comms.shape != (r_rows, c_chunks, k, 64)
+                or sub_blinds.shape != (r_rows, len(xs_new), c_chunks, 32)):
+            return None
+        for r in range(r_rows):
+            if not cm.reshare_verify_deal(grid_sum, xs_old[r], sub_comms[r],
+                                          xs_new, sub_rows[:, r, :],
+                                          sub_blinds[r]):
+                return None
+        try:
+            return ss.reshare_recover_rows(sub_rows, xs_new, k)
+        except ValueError:
+            return None
+
+    async def _reshare_recover(self, st: RoundState, miners: List[int],
+                               reachable: List[int], nodes: List[int],
+                               it: int) -> Optional[np.ndarray]:
+        """The distributed resharing round (docs/MEMBERSHIP.md): a miner
+        died after share intake, so the committee's share layout no
+        longer covers recovery by the seed protocol. The leader — acting
+        as the new epoch's coordinator — collects a verifiable RE-DEAL
+        of every surviving holder's aggregated slice (GetReshareDeal),
+        checks each against the homomorphically-evaluated original
+        commitments, reconstructs the surviving rows from the re-dealt
+        material alone, and completes recovery when ≥ poly_size rows
+        survive (r=2 redundancy tolerates half the committee, r=1.5 a
+        third). Returns the recovered aggregate, or None → empty block,
+        exactly the seed outcome."""
+        cfg = self.cfg
+        per = cfg.shares_per_miner
+        if len(reachable) * per < cfg.poly_size:
+            self._trace("reshare_short", survivors=len(reachable))
+            return None
+        grids = [st.miner_vss_records[n][0] for n in nodes
+                 if n in st.miner_vss_records]
+        if len(grids) != len(nodes):
+            self._trace("reshare_short", reason="missing vss records")
+            return None
+        self._bump_epoch("reshare_round")
+        xs_new = list(self._xs_all)
+        with self.tele.span("reshare_verify", it=it):
+            grid_sum = await asyncio.to_thread(cm.sum_commitment_grids,
+                                               grids)
+        if grid_sum is None:
+            return None
+        # our own slice needs no re-deal: the coordinator holds it
+        rows_parts: List[np.ndarray] = []
+        xs_parts: List[int] = []
+        own_idx = miners.index(self.id)
+        stack = np.stack([st.miner_shares[n] for n in nodes])
+        rows_parts.append(np.asarray(ss.aggregate_shares(stack)))
+        xs_parts.extend(self._xs_all[ss.miner_rows(cfg.total_shares,
+                                                   own_idx, len(miners))])
+        sig = self._sign(self._part_message(
+            "reshare", it, [len(nodes)] + nodes + xs_new)).hex()
+        for m in reachable:
+            if m == self.id:
+                continue
+            idx = miners.index(m)
+            xs_m = self._xs_all[ss.miner_rows(cfg.total_shares, idx,
+                                              len(miners))]
+            try:
+                _, deal = await self._call(m, "GetReshareDeal", {
+                    "iteration": it, "nodes": nodes, "xs_new": xs_new,
+                    "source_id": self.id, "sig": sig,
+                })
+            except Exception:
+                self._trace("reshare_deal_failed", peer=m)
+                continue
+            with self.tele.span("reshare_verify", it=it):
+                y_rows = await asyncio.to_thread(
+                    self._verify_reshare_deal, grid_sum, list(xs_m),
+                    xs_new, deal)
+            if y_rows is None:
+                self._trace("reshare_deal_rejected", peer=m)
+                continue
+            rows_parts.append(y_rows)
+            xs_parts.extend(xs_m)
+        if len(xs_parts) < cfg.poly_size:
+            self._trace("reshare_short", rows=len(xs_parts))
+            return None
+        full = np.concatenate(rows_parts)
+        with self.tele.span("recovery", it=it):
+            agg = np.asarray(ss.recover_update(
+                full, np.asarray(xs_parts, np.int64),
+                self.trainer.num_params, cfg.poly_size, cfg.precision))
+        self._trace("reshare_recovered", rows=len(xs_parts),
+                    survivors=len(reachable))
+        return agg
+
     # --------------------------------------------------- speculation plane
 
     def _maybe_speculate(self) -> None:
@@ -2400,8 +2876,14 @@ class PeerAgent:
             await self._verify_intake(st)
             _, miners, _, _ = self.role_map.committee()
             miners = sorted(miners)
-            # 1. agree on the contributor set: intersection across miners
+            # 1. agree on the contributor set: intersection across miners.
+            # Miners that fail the exchange are tracked as LOST: with the
+            # resharing plane armed (cfg.reshare) the round can still
+            # recover from the survivors' re-dealt shares — the seed
+            # behavior (a lost miner empties the intersection and the
+            # round) remains when resharing is off.
             node_sets = [set(self.round.miner_shares)]
+            reachable = [self.id]
             for m in miners:
                 if m == self.id:
                     continue
@@ -2412,8 +2894,13 @@ class PeerAgent:
                             "update-list", it, [])).hex(),
                     })
                     node_sets.append(set(int(x) for x in rmeta["sources"]))
+                    reachable.append(m)
                 except Exception:
-                    node_sets.append(set())
+                    if not self.cfg.reshare:
+                        node_sets.append(set())
+            lost = [m for m in miners if m not in reachable]
+            if lost and self.cfg.reshare:
+                self._trace("miner_lost", peers=sorted(lost))
             nodes = sorted(set.intersection(*node_sets)) if node_sets else []
             # aggregation-boundary re-check (docs §aggregated-vss): when
             # the agreed set covers the leader's intake batch only
@@ -2429,7 +2916,17 @@ class PeerAgent:
                 nodes = [n for n in nodes if n in st.miner_shares]
             rejected_ids = set(st.miner_rejected)
             agg = np.zeros(self.trainer.num_params, np.float64)
-            if nodes:
+            if nodes and lost and self.cfg.reshare:
+                # membership epoch bump: the committee lost a holder
+                # mid-round — run the distributed resharing round over
+                # the survivors and recover from the re-dealt shares
+                recovered = await self._reshare_recover(st, miners,
+                                                        reachable, nodes,
+                                                        it)
+                if recovered is None:
+                    return self._empty_block()
+                agg = recovered
+            elif nodes:
                 # 2. gather every miner's aggregated slice
                 slices: Dict[int, np.ndarray] = {}
                 ok = True
@@ -2450,14 +2947,33 @@ class PeerAgent:
                     except Exception:
                         ok = False
                 if not ok or len(slices) != len(miners):
-                    return self._empty_block()
-                # 3. reassemble rows and recover the aggregate
-                full = np.concatenate([slices[i] for i in range(len(miners))])
-                xs = self._xs_arr
-                with self.tele.span("recovery", it=it):
-                    agg = np.asarray(ss.recover_update(
-                        full, xs, self.trainer.num_params, cfg.poly_size,
-                        cfg.precision))
+                    # a miner died BETWEEN set agreement and slice
+                    # collection: same epoch bump, same resharing round —
+                    # survivors re-deal and recovery proceeds without the
+                    # lost rows (the guard inside _h_get_reshare_deal
+                    # accepts the identical aggregation set it already
+                    # served a plain slice for, and refuses any other)
+                    if not self.cfg.reshare:
+                        return self._empty_block()
+                    survivors = [self.id] + [
+                        m for i, m in enumerate(miners)
+                        if m != self.id and i in slices]
+                    self._trace("miner_lost", peers=sorted(
+                        m for m in miners if m not in survivors))
+                    recovered = await self._reshare_recover(
+                        st, miners, survivors, nodes, it)
+                    if recovered is None:
+                        return self._empty_block()
+                    agg = recovered
+                else:
+                    # 3. reassemble rows and recover the aggregate
+                    full = np.concatenate([slices[i]
+                                           for i in range(len(miners))])
+                    xs = self._xs_arr
+                    with self.tele.span("recovery", it=it):
+                        agg = np.asarray(ss.recover_update(
+                            full, xs, self.trainer.num_params,
+                            cfg.poly_size, cfg.precision))
             deltas = [Update(source_id=n, iteration=it,
                              delta=np.zeros(0, np.float64),
                              commitment=self.round.miner_commitments.get(n, b""),
@@ -2580,6 +3096,15 @@ class PeerAgent:
                     verifier=self.role_map.is_verifier(self.id),
                     miner=self.role_map.is_miner(self.id))
 
+        # seeded churn self-kill (--fault-churn, docs/MEMBERSHIP.md): this
+        # round is OUR scheduled death — exit cleanly so the launcher can
+        # relaunch us at the scheduled restart round. The in-process
+        # ChurnRunner kills from the outside instead (hard-crash
+        # semantics); both ride the same replayable schedule.
+        if it in self._churn_kills:
+            self._trace("churn_self_kill", height=it)
+            raise faults.ChurnExit(it)
+
         # random self-crash fault injection (ref: main.go:54-55,1117-1120)
         if cfg.fail_prob > 0 and self._rng.random() < cfg.fail_prob:
             self._trace("self_crash")
@@ -2674,11 +3199,14 @@ class PeerAgent:
         self._refresh_gauges()
         self.tele.flush()
 
-    async def _announce(self) -> None:
+    async def _announce(self, want_chain: bool = True) -> None:
         """Bootstrap: register with every peer concurrently, adopt the
         longest chain seen (ref: main.go:926-1024 — the reference announces
         serially; at N=100 a serial announce storm alone costs whole
-        rounds, so the fan-out runs as one gather).
+        rounds, so the fan-out runs as one gather). A snapshot-
+        bootstrapping joiner announces with `want_chain=False` (wire flag
+        `no_chain`): the hello still registers it everywhere, but chain
+        bodies stay off the wire — catch-up comes from GetSnapshot.
 
         Concurrency is bounded to the pool's connection cap: an unbounded
         gather keeps every dialed connection busy at once, so LRU eviction
@@ -2693,23 +3221,31 @@ class PeerAgent:
             try:
                 async with sem:
                     w, ln = self.chain.adoption_key()
-                    cmeta, carrays = await self._call(
-                        pid, "RegisterPeer",
-                        {"source_id": self.id, "host": self.peers[self.id][0],
-                         "port": self.peers[self.id][1],
-                         "have_weight": w, "have_blocks": ln,
-                         # wire-plane hello: what we can decode, plus a
-                         # reply-codec ask for the chain body (honoured
-                         # only by capable peers, ignored by legacy ones)
-                         "codecs": sorted(self.caps),
-                         **self._reply_codec_meta(pid)})
+                    hello = {"source_id": self.id,
+                             "host": self.peers[self.id][0],
+                             "port": self.peers[self.id][1],
+                             "have_weight": w, "have_blocks": ln,
+                             # wire-plane hello: what we can decode, plus
+                             # a reply-codec ask for the chain body
+                             # (honoured only by capable peers, ignored
+                             # by legacy ones)
+                             "codecs": sorted(self.caps),
+                             **self._reply_codec_meta(pid)}
+                    if not want_chain:
+                        hello["no_chain"] = True
+                    cmeta, carrays = await self._call(pid, "RegisterPeer",
+                                                      hello)
                 self._record_caps(pid, cmeta.get("codecs"))
+                if not want_chain:
+                    return
                 blocks = wire.unpack_chain(cmeta, carrays)
-                if blocks and await asyncio.to_thread(
-                        self._chain_quorums_ok, blocks):
-                    other = Blockchain.__new__(Blockchain)
-                    other.blocks = blocks
-                    self.chain.maybe_adopt(other)
+                if blocks:
+                    # quorum sweep off-loop (read-only); the adoption —
+                    # the chain MUTATION — on the loop, where no handler
+                    # can observe a half-swapped chain
+                    ok = await asyncio.to_thread(self._chain_quorums_ok,
+                                                 blocks)
+                    self._adopt_candidate(blocks, pid, quorums_ok=ok)
             except Exception:
                 pass
 
@@ -2741,7 +3277,8 @@ class PeerAgent:
                 # foreign ckpt-dir (different dims / num_nodes / stake)
                 # hashes to a different genesis and is refused, as is an
                 # empty chain or one with forged contributions
-                if self._chain_quorums_ok(restored.blocks) \
+                if self._chain_quorums_ok(restored.blocks,
+                                          restored.pruned_before) \
                         and self.chain.maybe_adopt(restored):
                     self._trace("checkpoint_restored",
                                 height=self.chain.latest.iteration)
@@ -2757,7 +3294,23 @@ class PeerAgent:
                 self._render_metrics, self.cfg.my_ip,
                 self.cfg.metrics_port + self.id)
         if self.id != 0:
-            await self._announce()
+            if self.cfg.snapshot_bootstrap:
+                # membership plane: hello everywhere WITHOUT chain bodies,
+                # then catch up from one peer's sealed snapshot — the
+                # pre-snapshot history never crosses the wire
+                await self._announce(want_chain=False)
+                await self._snapshot_bootstrap()
+            else:
+                await self._announce()
+        # a RELAUNCHED incarnation rebuilds the same churn schedule from
+        # the same flags — kill rounds at or below the history it just
+        # adopted (checkpoint restore and/or announce) were already
+        # executed by the previous incarnation and must not re-fire: a
+        # supervisor-relaunched peer re-traversing its own kill round
+        # would otherwise die again in a clean-exit loop. A genesis
+        # launch adopts nothing, so its full schedule survives this.
+        self._churn_kills = frozenset(r for r in self._churn_kills
+                                      if r > self.iteration)
         try:
             while not self.converged \
                     and self.iteration < self.cfg.max_iterations:
@@ -2779,6 +3332,21 @@ class PeerAgent:
                     await asyncio.to_thread(ckpt.save, self.chain,
                                             self.ckpt_dir)
                     await asyncio.to_thread(ckpt.prune, self.ckpt_dir, 3)
+        except faults.ChurnExit:
+            # scheduled self-kill (--fault-churn): an abrupt but CLEAN
+            # exit — sockets released synchronously so the relaunched
+            # incarnation can rebind immediately, spill drained, NO crash
+            # dump (scripted chaos is not a failure). The launcher
+            # relaunches at the scheduled restart round; rejoin then goes
+            # through checkpoint restore + announce (or snapshot
+            # bootstrap) like any other restart.
+            self.server.close_now()
+            self.pool.close()
+            if self._metrics_server is not None:
+                self._metrics_server.close()
+            snapshot = self.telemetry_snapshot()
+            self.tele.close()
+            return self._result(snapshot, churned=True)
         except asyncio.CancelledError:
             # routine teardown (a harness cancelling the task, Ctrl-C):
             # drain the batched spill so the event log is complete, but a
@@ -2823,11 +3391,18 @@ class PeerAgent:
             self._metrics_server.close()
         snapshot = self.telemetry_snapshot()
         self.tele.close()  # final flush of the batched spill
-        return {
+        return self._result(snapshot, chain_dump=dump)
+
+    def _result(self, snapshot: Dict, chain_dump: Optional[str] = None,
+                **extra) -> Dict:
+        """The run() result schema, shared by the normal exit and the
+        churn self-kill exit (which additionally flags `churned`)."""
+        out = {
             "node": self.id,
             "iterations": self.iteration,
             "converged": self.converged,
-            "chain_dump": dump,
+            "chain_dump": (chain_dump if chain_dump is not None
+                           else self.chain.dump()),
             "final_error": self.logs[-1][1] if self.logs else float("nan"),
             "logs": [f"{i},{e:.6f},{t:.6f}" for i, e, t in self.logs],
             # attack/security accounting, printed at exit by the reference
@@ -2845,6 +3420,8 @@ class PeerAgent:
             # this; the flat keys above stay as the back-compat view
             "telemetry": snapshot,
         }
+        out.update(extra)
+        return out
 
     def _render_metrics(self) -> str:
         """Prometheus page for the optional HTTP endpoint — gauges are

@@ -108,6 +108,24 @@ def main(argv=None) -> int:
     ap.add_argument("--admission", type=int, default=-1,
                     help="1 arms the overload-governance plane on every "
                          "peer; 0 disables; default: armed iff --flood")
+    ap.add_argument("--churn", type=float, default=0.0,
+                    help="membership fraction killed+restarted per churn "
+                         "window (0.2 = the ISSUE's 20%% per 10 rounds); "
+                         "window-0 victims become late joiners. The "
+                         "oracle switches to the SURVIVING-prefix "
+                         "comparison (docs/MEMBERSHIP.md)")
+    ap.add_argument("--churn-seed", type=int, default=-1,
+                    help="seed for the churn schedule (default: "
+                         "--fault-seed) — same seed replays the "
+                         "identical join/leave timeline")
+    ap.add_argument("--churn-period", type=int, default=10,
+                    help="rounds per churn window")
+    ap.add_argument("--churn-down", type=int, default=3,
+                    help="rounds a churned peer stays down")
+    ap.add_argument("--snapshot-bootstrap", type=int, default=0,
+                    help="1: churned/late peers catch up from a chain "
+                         "snapshot (GetSnapshot) instead of replaying "
+                         "genesis")
     ns = ap.parse_args(argv)
     if ns.flood and not (0 <= ns.flood_node < ns.nodes):
         ap.error(f"--flood-node {ns.flood_node} outside 0..{ns.nodes - 1}")
@@ -120,15 +138,27 @@ def main(argv=None) -> int:
     from biscotti_tpu.runtime.faults import FaultPlan
     from biscotti_tpu.runtime.peer import PeerAgent
 
+    churn_seed = ns.fault_seed if ns.churn_seed < 0 else ns.churn_seed
+    # one plan: the frame-fault schedule keys off --fault-seed, the
+    # membership timeline off --churn-seed (FaultPlan.churn_seed) — so a
+    # churn ablation varying only --churn-seed replays the identical
+    # drop/delay/dup/reset schedule
     plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
                      delay=ns.fault_delay, delay_s=ns.fault_delay_s,
-                     duplicate=ns.fault_dup, reset=ns.fault_reset)
+                     duplicate=ns.fault_dup, reset=ns.fault_reset,
+                     churn=ns.churn, churn_period=ns.churn_period,
+                     churn_down=ns.churn_down, churn_seed=ns.churn_seed)
     # the flooder rides the SAME seeded plan plus the replay factor, so
-    # a mixed run (drop + flood) stays replayable from one seed
+    # a mixed run (drop + flood + churn) stays replayable from one seed —
+    # dropping the churn fields here would silently strip a flooding
+    # victim's self-kill schedule and change the membership timeline
     flood_plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
                            delay=ns.fault_delay, delay_s=ns.fault_delay_s,
                            duplicate=ns.fault_dup, reset=ns.fault_reset,
-                           flood=ns.flood)
+                           flood=ns.flood,
+                           churn=ns.churn, churn_period=ns.churn_period,
+                           churn_down=ns.churn_down,
+                           churn_seed=ns.churn_seed)
     admit = bool(ns.flood) if ns.admission < 0 else bool(ns.admission)
     # harness-scaled budgets: a 4-node fast-timeout loopback cluster's
     # honest rate is well under 1 frame/s/peer/class, so these rates are
@@ -155,14 +185,30 @@ def main(argv=None) -> int:
             breaker_cooldown_s=ns.breaker_cooldown_s,
             fault_plan=flood_plan if flooding else plan,
             admission_plan=admission,
+            snapshot_bootstrap=bool(ns.snapshot_bootstrap),
             wire_codec=ns.codec)
 
-    async def go():
-        agents = [PeerAgent(cfg(i)) for i in range(ns.nodes)]
-        return await asyncio.gather(*(a.run() for a in agents))
+    if ns.churn > 0:
+        from biscotti_tpu.runtime.membership import (ChurnRunner,
+                                                     surviving_prefix_oracle)
 
-    results = asyncio.run(go())
-    prefix_equal, common, real_blocks = chain_oracle(results)
+        schedule = plan.churn_schedule(ns.nodes, ns.rounds)
+
+        async def go():
+            runner = ChurnRunner(lambda i: PeerAgent(cfg(i)), ns.nodes,
+                                 schedule)
+            return await runner.run(), runner.events_applied
+
+        results, applied = asyncio.run(go())
+        prefix_equal, common, real_blocks = surviving_prefix_oracle(results)
+    else:
+        async def go():
+            agents = [PeerAgent(cfg(i)) for i in range(ns.nodes)]
+            return await asyncio.gather(*(a.run() for a in agents))
+
+        results = asyncio.run(go())
+        applied = None
+        prefix_equal, common, real_blocks = chain_oracle(results)
     faults_fired = tally_faults(results)
     # every robustness readout below comes off the telemetry snapshots —
     # the same schema the Metrics RPC serves a live scrape, so a chaos
@@ -177,6 +223,10 @@ def main(argv=None) -> int:
                        "duplicate": plan.duplicate, "reset": plan.reset},
         "flood": {"factor": ns.flood, "node": ns.flood_node}
                  if ns.flood else None,
+        "churn": {"fraction": ns.churn, "seed": churn_seed,
+                  "period": ns.churn_period, "down": ns.churn_down,
+                  "events_applied": applied}
+                 if ns.churn else None,
         "admission_enabled": admit,
         "settled_prefix_equal": prefix_equal,
         "settled_height": common,

@@ -158,6 +158,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         quarantined = sorted(p for p, h in health.items()
                              if h.get("state") in OPEN_STATES)
         breakers_open += len(quarantined)
+        member = s.get("membership") or {}
         per_node.append({
             "node": s.get("node"),
             "iter": s.get("iter", 0),
@@ -167,6 +168,11 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
             "fast_fails": sum(h.get("fast_fails", 0)
                               for h in health.values()),
             "faults": dict(s.get("faults") or {}),
+            # membership plane (docs/MEMBERSHIP.md): this peer's observed
+            # epoch + live-set size (and whether it bootstrapped pruned)
+            "epoch": int(member.get("epoch", 0)),
+            "alive": int(member.get("alive", 0)),
+            "pruned_before": int(member.get("pruned_before", 0)),
         })
     hs = list(heights.values()) or [0]
     wire = merge_wire(snaps)
@@ -177,6 +183,12 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "nodes": len(snaps),
         "round_height": {"min": min(hs), "max": max(hs),
                          "skew": max(hs) - min(hs)},
+        "membership": {
+            "max_epoch": max((n["epoch"] for n in per_node), default=0),
+            "joins": counters.get("member_join", 0),
+            "leaves": counters.get("member_leave", 0),
+            "reshare_rounds": counters.get("reshare_round", 0),
+        },
         "breakers_open": breakers_open,
         "faults": faults,
         "counters": counters,
@@ -195,8 +207,8 @@ def format_table(merged: Dict) -> str:
         f"round height {rh['min']}..{rh['max']} (skew {rh['skew']})   "
         f"breakers open: {merged['breakers_open']}",
         "",
-        f"{'node':>5} {'iter':>5} {'conv':>5} {'opens':>6} "
-        f"{'fastfail':>8}  quarantined / faults",
+        f"{'node':>5} {'iter':>5} {'conv':>5} {'epoch':>6} {'alive':>6} "
+        f"{'opens':>6} {'fastfail':>8}  quarantined / faults",
     ]
     for n in merged["per_node"]:
         extra = []
@@ -205,8 +217,11 @@ def format_table(merged: Dict) -> str:
         if n["faults"]:
             extra.append("faults=" + ",".join(
                 f"{k}:{v}" for k, v in sorted(n["faults"].items())))
+        if n.get("pruned_before"):
+            extra.append(f"pruned<{n['pruned_before']}")
         lines.append(f"{n['node']!s:>5} {n['iter']:>5} "
-                     f"{str(n['converged'])[:1]:>5} {n['breaker_opens']:>6} "
+                     f"{str(n['converged'])[:1]:>5} {n.get('epoch', 0):>6} "
+                     f"{n.get('alive', 0):>6} {n['breaker_opens']:>6} "
                      f"{n['fast_fails']:>8}  {' '.join(extra)}")
     wire = merged.get("wire") or {}
     if wire.get("out_bytes") or wire.get("in_bytes"):

@@ -66,9 +66,38 @@ def _block_meta(blk: Block) -> Dict:
     }
 
 
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms that refuse O_RDONLY on dirs: rename is still atomic
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save(chain: Blockchain, directory: str, step: Optional[int] = None) -> str:
-    """Atomically write a snapshot of the full chain; returns the snapshot
-    path. Layout: <dir>/step_<height>/{manifest.json, blocks.npz}."""
+    """Durably + atomically write a snapshot of the full chain; returns the
+    snapshot path. Layout: <dir>/step_<height>/{manifest.json, blocks.npz}.
+
+    Write protocol: everything lands in a temp dir first, every file is
+    fsync'd, then ONE rename commits the step and the parent directory is
+    fsync'd. A peer killed at ANY instant — mid-.npz write, mid-rename,
+    before the dir entry is durable — therefore leaves either the complete
+    committed step or no step at all; it can never leave a truncated
+    blocks.npz under the committed name that poisons its own rejoin
+    (docs/MEMBERSHIP.md §rejoin)."""
     step = chain.latest.iteration if step is None else step
     final = os.path.join(directory, f"step_{step}")
     os.makedirs(directory, exist_ok=True)
@@ -81,11 +110,23 @@ def save(chain: Blockchain, directory: str, step: Optional[int] = None) -> str:
             metas.append(_block_meta(blk))
         np.savez_compressed(os.path.join(tmp, "blocks.npz"), **arrays)
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            # pruned-chain state must round-trip: a snapshot-bootstrapped
+            # peer's chain has a deliberate gap below pruned_before, and a
+            # checkpoint that dropped it would fail its own verify() on
+            # reload (poisoning every rejoin-from-checkpoint). Absent keys
+            # default to 0 — old checkpoints stay loadable.
             json.dump({"version": 1, "num_blocks": len(chain.blocks),
+                       "pruned_before": chain.pruned_before,
+                       "pruned_weight": chain.pruned_weight,
                        "blocks": metas}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_file(os.path.join(tmp, "blocks.npz"))
+        _fsync_dir(tmp)
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)  # atomic commit (same filesystem)
+        _fsync_dir(directory)  # make the committed name itself durable
         return final
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -105,13 +146,43 @@ def list_steps(directory: str) -> List[int]:
     return sorted(out)
 
 
-def load(directory: str, step: Optional[int] = None) -> Blockchain:
-    """Load and VERIFY a snapshot; raises ChainInvariantError on tampering,
-    FileNotFoundError when no snapshot exists."""
+def load(directory: str, step: Optional[int] = None,
+         report: Optional[List] = None) -> Blockchain:
+    """Load and VERIFY a snapshot; raises FileNotFoundError when no
+    snapshot exists.
+
+    step=None: walk steps NEWEST first, SKIP any corrupt one — bad zip,
+    bad JSON, structurally wrong manifest, failed chain verify — and
+    return the newest intact snapshot; each skip is recorded in `report`
+    (a caller-supplied list receiving (step, \"reason\") tuples) so a
+    caller can trace what was refused instead of crashing on it. Only
+    when EVERY step is corrupt does the last error propagate (a dir
+    holding nothing but garbage still fails loudly). Note PeerAgent.run's
+    rejoin walks steps itself (via list_steps + explicit-step loads)
+    because it interleaves per-step quorum/adoption checks this module
+    cannot know about — this walk is for every OTHER consumer (tools,
+    tests, offline inspection) so the skip policy lives in one place.
+
+    An explicit `step` stays STRICT — tampering with a named snapshot
+    raises (ChainInvariantError etc.), it is never silently skipped."""
     steps = list_steps(directory)
     if not steps:
         raise FileNotFoundError(f"no checkpoints under {directory}")
-    step = steps[-1] if step is None else step
+    if step is None:
+        last_err: Optional[BaseException] = None
+        for s in reversed(steps):
+            try:
+                return _load_step(directory, s)
+            except Exception as e:
+                last_err = e
+                if report is not None:
+                    report.append((s, f"{type(e).__name__}: {e}"))
+        assert last_err is not None
+        raise last_err
+    return _load_step(directory, step)
+
+
+def _load_step(directory: str, step: int) -> Blockchain:
     path = os.path.join(directory, f"step_{step}")
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
@@ -151,6 +222,8 @@ def load(directory: str, step: Optional[int] = None) -> Blockchain:
         blocks.append(blk)
     chain = Blockchain.__new__(Blockchain)
     chain.blocks = blocks
+    chain.pruned_before = int(manifest.get("pruned_before", 0))
+    chain.pruned_weight = int(manifest.get("pruned_weight", 0))
     chain.verify()  # refuse tampered/torn snapshots
     return chain
 

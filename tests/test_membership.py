@@ -1,0 +1,718 @@
+"""Membership plane: seeded churn, snapshot bootstrap, distributed
+resharing (docs/MEMBERSHIP.md).
+
+Unit level: churn-schedule determinism, the reshare kernels
+(share-of-shares dealing, exact rational recovery, homomorphic Pedersen
+binding), pruned-chain semantics, checkpoint corruption skipping, and
+the traced refusal reasons for stale/foreign chains and snapshots.
+
+Integration level (`-m churn` isolates): a live cluster under the seeded
+join/kill/restart schedule must hold the SURVIVING-prefix oracle; a
+miner hard-killed after share intake must not cost the round its real
+block (the resharing round recovers across the epoch); a late joiner
+must reach the cluster's height from a snapshot without pre-snapshot
+blocks crossing the wire (wire byte accounting).
+
+The heavier 20%-per-10-rounds acceptance run with the poisoning defense
+armed is `slow`+`churn`.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.crypto import commitments as cm
+from biscotti_tpu.ledger.block import Block, BlockData, Update
+from biscotti_tpu.ledger.chain import Blockchain, ChainInvariantError
+from biscotti_tpu.ops import secretshare as ss
+from biscotti_tpu.runtime import faults, membership
+from biscotti_tpu.runtime.faults import ChurnEvent, FaultPlan
+from biscotti_tpu.runtime.membership import (ChurnRunner,
+                                             surviving_prefix_oracle)
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.utils import checkpoint as ckpt
+
+FAST = Timeouts(update_s=5.0, block_s=15.0, krum_s=3.0, share_s=5.0,
+                rpc_s=4.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=3, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+from conftest import wait_until as _wait_until  # noqa: E402
+
+
+# ------------------------------------------------------- churn schedule
+
+
+def test_churn_schedule_deterministic_replayable():
+    plan = FaultPlan(seed=14, churn=0.25, churn_period=4, churn_down=2)
+    ev = plan.churn_schedule(5, 12)
+    # pure in the seed: a fresh plan replays the identical timeline
+    assert ev == FaultPlan(seed=14, churn=0.25, churn_period=4,
+                           churn_down=2).churn_schedule(5, 12)
+    assert ev != FaultPlan(seed=15, churn=0.25, churn_period=4,
+                           churn_down=2).churn_schedule(5, 12)
+    assert ev, "operating point produced no events"
+    # node 0 is the anchor: never churned
+    assert all(e.node != 0 for e in ev)
+    # every KILL inside the run pairs with a RESTART churn_down later
+    kills = {(e.round, e.node) for e in ev if e.kind == faults.KILL}
+    restarts = {(e.round, e.node) for e in ev if e.kind == faults.RESTART}
+    for r, node in kills:
+        if r + 2 < 12:
+            assert (r + 2, node) in restarts
+    # window-0 victims join late instead of launching at genesis
+    joins = [e for e in ev if e.kind == faults.JOIN]
+    assert all(0 < e.round < 4 for e in joins)
+    # churn_seed override: the membership timeline keys off churn_seed
+    # while the frame-fault schedule stays on `seed` — a churn ablation
+    # varying only the timeline must not reshuffle drop/delay draws
+    a = FaultPlan(seed=1, drop=0.5, churn=0.25, churn_period=4,
+                  churn_down=2, churn_seed=14)
+    b = FaultPlan(seed=9, drop=0.5, churn=0.25, churn_period=4,
+                  churn_down=2, churn_seed=14)
+    assert a.churn_schedule(5, 12) == b.churn_schedule(5, 12) == ev
+    assert [a.action(0, 1, "RegisterUpdate", 0, s).kind()
+            for s in range(64)] != \
+        [b.action(0, 1, "RegisterUpdate", 0, s).kind() for s in range(64)]
+
+
+def test_churn_disabled_plan_is_empty_and_frame_plane_untouched():
+    plan = FaultPlan(seed=7)
+    assert not plan.churn_enabled
+    assert plan.churn_schedule(10, 100) == []
+    # churn alone must NOT arm per-frame injection
+    churny = FaultPlan(seed=7, churn=0.5)
+    assert churny.churn_enabled and not churny.enabled
+
+
+def test_membership_knobs_ride_the_cli():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    BiscottiConfig.add_args(ap)
+    ns = ap.parse_args(["--fault-churn", "0.2", "--fault-churn-period",
+                        "5", "--fault-churn-down", "2",
+                        "--snapshot-bootstrap", "1", "--snapshot-tail",
+                        "4", "--reshare", "0"])
+    cfg = BiscottiConfig.from_args(ns)
+    assert cfg.fault_plan.churn == 0.2
+    assert cfg.fault_plan.churn_period == 5
+    assert cfg.fault_plan.churn_down == 2
+    assert cfg.fault_plan.churn_enabled and not cfg.fault_plan.enabled
+    assert cfg.snapshot_bootstrap and cfg.snapshot_tail == 4
+    assert not cfg.reshare
+    with pytest.raises(ValueError):
+        BiscottiConfig(fault_plan=FaultPlan(churn=1.5))
+    with pytest.raises(ValueError):
+        BiscottiConfig(snapshot_tail=0)
+
+
+# ------------------------------------------------------ reshare kernels
+
+
+def _vss_instance(d=25, k=10, s=16, seed=b"\x01" * 32, ctx=b"ctx", rng=0):
+    q = np.random.default_rng(rng).integers(-10**6, 10**6,
+                                            size=d).astype(np.int64)
+    c = ss.num_chunks(d, k)
+    padded = np.zeros(c * k, np.int64)
+    padded[:d] = q
+    comms, blind_bytes = cm.vss_commit_chunks_bytes(
+        padded.reshape(c, k), seed, ctx)
+    xs = [x - ss.SHARE_OFFSET for x in range(s)]
+    shares = ss.make_shares(q, k, s)
+    blind_rows = cm.vss_blind_rows_bytes(blind_bytes, c, k, xs)
+    return q, c, xs, shares, comms, blind_rows
+
+
+def test_reshare_two_level_recovery_exact():
+    """Every holder re-deals; the secret reconstructs EXACTLY from the
+    re-dealt material alone — including from any poly_size-of-S' subset
+    of the new holders (the dealerless re-provisioning property)."""
+    k = 10
+    q, c, xs, shares, _, _ = _vss_instance()
+    coeffs = ss.reshare_coeffs(shares, k, b"holder", b"ctx")
+    assert np.array_equal(coeffs[:, :, 0], shares)
+    sub = ss.reshare_subshares(coeffs, xs)           # [S', S, C]
+    rec_rows = ss.reshare_recover_rows(sub, xs, k)
+    assert np.array_equal(rec_rows, shares)
+    q2 = ss.from_chunks(ss.recover_coeffs(rec_rows,
+                                          np.asarray(xs, np.int64), k), len(q))
+    assert np.array_equal(np.asarray(q2), q)
+    # any k new holders suffice
+    part = ss.reshare_recover_rows(sub[3:13], xs[3:13], k)
+    assert np.array_equal(part, shares)
+    # fewer than k cannot determine the sub-polynomials
+    with pytest.raises(ValueError):
+        ss.reshare_recover_rows(sub[:k - 1], xs[:k - 1], k)
+    # a corrupted sub-share breaks exact integer divisibility → loud
+    bad = sub[:k].copy()
+    bad[0, 0, 0] += 1
+    with pytest.raises(ValueError):
+        ss.reshare_recover_rows(bad, xs[:k], k)
+
+
+def test_reshare_deal_homomorphic_binding():
+    """The sub-deal's constant commitments must equal the homomorphic
+    evaluation of the ORIGINAL commitments at the holder's point: an
+    honest deal verifies, a holder lying about its row value — or
+    claiming another holder's point — is refused."""
+    k = 10
+    _, c, xs, shares, comms, blind_rows = _vss_instance()
+    r = 3
+    coeffs = ss.reshare_coeffs(shares[r:r + 1], k, b"holder", b"ctx")
+    sub = ss.reshare_subshares(coeffs, xs)
+    blind0 = [int.from_bytes(bytes(blind_rows[r, ci]), "little")
+              for ci in range(c)]
+    sub_comms, sub_blinds = cm.reshare_commit_row(coeffs[0], blind0,
+                                                  b"holder", b"ctx")
+    sub_brows = cm.vss_blind_rows(sub_blinds, xs)
+    assert cm.reshare_verify_deal(comms, xs[r], sub_comms, xs,
+                                  sub[:, 0, :], sub_brows)
+    # wrong old point: binding fails
+    assert not cm.reshare_verify_deal(comms, xs[r + 1], sub_comms, xs,
+                                      sub[:, 0, :], sub_brows)
+    # lying holder: +1 on the row value, otherwise self-consistent deal
+    lie = coeffs.copy()
+    lie[0, :, 0] += 1
+    lie_comms, lie_blinds = cm.reshare_commit_row(lie[0], blind0,
+                                                  b"holder", b"ctx")
+    lie_sub = ss.reshare_subshares(lie, xs)
+    lie_brows = cm.vss_blind_rows(lie_blinds, xs)
+    assert not cm.reshare_verify_deal(comms, xs[r], lie_comms, xs,
+                                      lie_sub[:, 0, :], lie_brows)
+    # corrupted sub-share against an honest deal: VSS side fails
+    tam = np.array(sub[:, 0, :])
+    tam[2, 0] += 1
+    assert not cm.reshare_verify_deal(comms, xs[r], sub_comms, xs,
+                                      tam, sub_brows)
+
+
+def test_reshare_aggregated_slice_binds_to_summed_commitments():
+    """Pedersen is additive: the grid/blind sums of the contributors ARE
+    the commitment of the aggregated slice, so a holder's re-deal of an
+    AGGREGATE verifies against material every miner already holds."""
+    k, s = 10, 16
+    insts = [_vss_instance(seed=bytes([w + 5]) * 32, rng=w + 1)
+             for w in range(3)]
+    c = insts[0][1]
+    xs = insts[0][2]
+    agg_shares = np.sum([i[3] for i in insts], axis=0)
+    agg_comms = cm.sum_commitment_grids([i[4] for i in insts])
+    agg_blinds = cm.sum_blind_rows([i[5] for i in insts])
+    r = 5
+    coeffs = ss.reshare_coeffs(agg_shares[r:r + 1], k, b"h", b"ctx")
+    sub = ss.reshare_subshares(coeffs, xs)
+    sc, sb = cm.reshare_commit_row(coeffs[0], agg_blinds[r], b"h", b"ctx")
+    assert cm.reshare_verify_deal(agg_comms, xs[r], sc, xs,
+                                  sub[:, 0, :], cm.vss_blind_rows(sb, xs))
+
+
+# ------------------------------------------------ checkpoint durability
+
+
+def test_checkpoint_load_skips_corrupt_steps_with_report(tmp_path):
+    chain = Blockchain(8, num_nodes=3, default_stake=10)
+    ckpt.save(chain, str(tmp_path), step=1)
+    # two corrupt newer steps: torn manifest, truncated npz
+    os.makedirs(tmp_path / "step_5")
+    with open(tmp_path / "step_5" / "manifest.json", "w") as f:
+        f.write("{torn")
+    os.makedirs(tmp_path / "step_9")
+    with open(tmp_path / "step_9" / "manifest.json", "w") as f:
+        json.dump({"version": 1, "num_blocks": 1,
+                   "blocks": [{"iteration": 0, "prev_hash": "00",
+                               "hash": "00", "deltas": []}]}, f)
+    with open(tmp_path / "step_9" / "blocks.npz", "wb") as f:
+        f.write(b"not a zip")
+    report = []
+    loaded = ckpt.load(str(tmp_path), report=report)
+    assert loaded.dump() == chain.dump()
+    assert sorted(s for s, _ in report) == [5, 9]
+    assert all(why for _, why in report)
+    # an explicitly named corrupt step stays STRICT
+    with pytest.raises(Exception):
+        ckpt.load(str(tmp_path), step=9)
+    # a dir holding only garbage still fails loudly
+    os.rename(tmp_path / "step_1", tmp_path / "not_a_step")
+    with pytest.raises(Exception):
+        ckpt.load(str(tmp_path))
+
+
+# --------------------------------------------------- pruned chain model
+
+
+def _grow(chain: Blockchain, n: int, nonempty=True) -> None:
+    for _ in range(n):
+        deltas = []
+        if nonempty:
+            deltas = [Update(source_id=1,
+                             iteration=chain.next_iteration,
+                             delta=np.ones(4), accepted=True)]
+        chain.add_block(Block(
+            data=BlockData(iteration=chain.next_iteration,
+                           global_w=chain.latest_gradient() + 1.0,
+                           deltas=deltas),
+            prev_hash=chain.latest_hash(),
+            stake_map=chain.latest_stake_map()).seal())
+
+
+def test_pruned_chain_semantics():
+    full = Blockchain(4, num_nodes=3, default_stake=10)
+    _grow(full, 8)
+    # snapshot shape: genesis + the last 4 blocks
+    pruned = Blockchain.__new__(Blockchain)
+    pruned.blocks = [full.blocks[0]] + full.blocks[-4:]
+    pruned.pruned_before = pruned.blocks[1].iteration
+    pruned.pruned_weight = 4
+    pruned.verify()  # exactly one gap allowed
+    assert pruned.latest.hash == full.latest.hash
+    assert pruned.next_iteration == full.next_iteration
+    # height mapping: genesis, absent range, suffix
+    assert pruned.get_block(-1).iteration == -1
+    assert pruned.get_block(0) is None
+    assert pruned.get_block(2) is None
+    for it in range(4, 8):
+        assert pruned.get_block(it).hash == full.get_block(it).hash
+    # fork-choice key counts the pruned range via the claim
+    assert pruned.adoption_key() == full.adoption_key()
+    # the dump is honest about what it never held
+    assert "pruned heights=0..3" in pruned.dump()
+    # growth continues normally off the suffix head
+    _grow(pruned, 1)
+    pruned.verify()
+    # a SECOND gap (tampered suffix ordering) is still refused
+    bad = Blockchain.__new__(Blockchain)
+    bad.blocks = [full.blocks[0], full.blocks[5], full.blocks[8]]
+    bad.pruned_before = 4
+    with pytest.raises(ChainInvariantError):
+        bad.verify()
+
+
+def test_checkpoint_roundtrips_pruned_chain(tmp_path):
+    """A snapshot-bootstrapped peer's checkpoint must round-trip its
+    pruned state: save() persists pruned_before/pruned_weight and load()
+    restores them before verify() — otherwise every checkpoint such a
+    peer writes would fail its own structural check on reload and
+    silently poison rejoin-from-checkpoint."""
+    full = Blockchain(4, num_nodes=3, default_stake=10)
+    _grow(full, 8)
+    pruned = Blockchain.__new__(Blockchain)
+    pruned.blocks = [full.blocks[0]] + full.blocks[-4:]
+    pruned.pruned_before = pruned.blocks[1].iteration
+    pruned.pruned_weight = 4
+    ckpt.save(pruned, str(tmp_path))
+    loaded = ckpt.load(str(tmp_path))
+    assert loaded.pruned_before == pruned.pruned_before
+    assert loaded.pruned_weight == pruned.pruned_weight
+    assert loaded.dump() == pruned.dump()
+    assert loaded.adoption_key() == full.adoption_key()
+
+
+def test_pruned_checkpoint_restores_through_quorum_gate(tmp_path):
+    """run()'s checkpoint-restore gate must verify a PRUNED chain's
+    quorums from above the trust-anchor base: checking blocks[1] (the
+    base, across the gap) against the genesis committee would reject
+    every checkpoint a snapshot-bootstrapped peer writes, silently
+    restarting it from genesis on every relaunch."""
+    agent = PeerAgent(_cfg(0, 3, 15918, verification=True))
+    donor = Blockchain(agent.trainer.num_params, num_nodes=3,
+                       default_stake=10)
+    _grow(donor, 5)                   # non-empty history
+    _grow(donor, 3, nonempty=False)   # sealed empty suffix
+    pruned = Blockchain.__new__(Blockchain)
+    pruned.blocks = [donor.blocks[0]] + donor.blocks[-4:]  # base: height 4
+    pruned.pruned_before = pruned.blocks[1].iteration
+    pruned.pruned_weight = 4
+    pruned.verify()
+    ckpt.save(pruned, str(tmp_path))
+    restored = ckpt.load(str(tmp_path))
+    assert restored.pruned_before == pruned.pruned_before
+    # the naive full-chain gate rejects the non-empty base across the
+    # gap (it can only check it against the genesis committee)…
+    assert not agent._chain_quorums_ok(restored.blocks)
+    # …the pruned-aware gate starts above the trust anchor — exactly
+    # what run() passes — and the restore adopts
+    assert agent._chain_quorums_ok(restored.blocks,
+                                   restored.pruned_before)
+    assert agent.chain.maybe_adopt(restored)
+    assert agent.chain.pruned_before == pruned.pruned_before
+
+
+# ------------------------------------------- refusal reasons on rejoin
+
+
+def test_foreign_and_unauthenticated_chain_refusals_traced():
+    """ISSUE 8 satellite: a rejoining peer offered (a) a chain grown from
+    a DIFFERENT genesis and (b) a quorum-unauthenticated chain must
+    refuse both with a traced reason; (c) a shorter chain is refused as
+    not-heavier before any crypto runs."""
+    cfg = _cfg(0, 3, 15910, verification=True)
+    agent = PeerAgent(cfg)
+    _grow(agent.chain, 2)
+
+    # (a) foreign genesis (different stake layout → different hash)
+    foreign = Blockchain(agent.trainer.num_params, num_nodes=3,
+                         default_stake=99)
+    _grow(foreign, 5)
+    assert not agent._adopt_candidate(foreign.blocks, source=1)
+    # (b) heavier chain from OUR genesis whose non-empty blocks carry no
+    # verifier quorums: refused as unauthenticated
+    unauth = Blockchain(agent.trainer.num_params, num_nodes=3,
+                        default_stake=10)
+    _grow(unauth, 5)
+    assert not agent._adopt_candidate(unauth.blocks, source=2)
+    # (c) shorter-than-ours: refused before any signature work
+    short = Blockchain(agent.trainer.num_params, num_nodes=3,
+                       default_stake=10)
+    _grow(short, 1)
+    assert not agent._adopt_candidate(short.blocks, source=2)
+    counts = agent.counters
+    assert counts.get("chain_refused", 0) == 3
+    reasons = [e.get("reason") for e in agent.tele.recorder.tail(10)
+               if e.get("event") == "chain_refused"]
+    assert sorted(reasons) == ["genesis_mismatch", "not_heavier",
+                               "quorum_unauthenticated"]
+    assert agent.chain.latest.iteration == 1  # nothing was adopted
+
+
+def test_snapshot_refusals_traced():
+    cfg = _cfg(0, 3, 15912, verification=False)
+    agent = PeerAgent(cfg)
+    # a healthy donor cluster's snapshot
+    donor = Blockchain(agent.trainer.num_params, num_nodes=3,
+                       default_stake=10)
+    _grow(donor, 8)
+    snap = [donor.blocks[0]] + donor.blocks[-4:]
+    # a Byzantine donor's inflated weight claim is clamped to the pruned
+    # range's length (one non-empty block per height is the physical
+    # max) — an over-claim must not capture this peer's fork choice
+    # against every future honest offer
+    assert agent._adopt_snapshot(list(snap), pruned_weight=10**9, source=1)
+    assert agent.chain.pruned_before == snap[1].iteration
+    assert agent.chain.pruned_weight == agent.chain.pruned_before
+    assert agent.chain.latest.hash == donor.latest.hash
+
+    # mismatched genesis: refused outright
+    fresh = PeerAgent(_cfg(1, 3, 15914, verification=False))
+    foreign = Blockchain(fresh.trainer.num_params, num_nodes=3,
+                         default_stake=99)
+    _grow(foreign, 8)
+    fsnap = [foreign.blocks[0]] + foreign.blocks[-4:]
+    assert not fresh._adopt_snapshot(fsnap, pruned_weight=4, source=1)
+    # a torn suffix (link severed mid-suffix): structural refusal
+    torn = [donor.blocks[0]] + donor.blocks[-4:-2] + donor.blocks[-1:]
+    assert not fresh._adopt_snapshot(torn, pruned_weight=4, source=1)
+    assert fresh.counters.get("snapshot_refused", 0) == 2
+    reasons = [e.get("reason") for e in fresh.tele.recorder.tail(10)
+               if e.get("event") == "snapshot_refused"]
+    assert "genesis_mismatch" in reasons
+    assert fresh.chain.latest.iteration == -1
+
+
+def test_snapshot_suffix_quorums_enforced():
+    """With verification armed, a snapshot whose sealed suffix carries
+    non-empty blocks WITHOUT verifier quorums is refused — the sealed
+    suffix extends the live quorum refusal logic, it does not bypass
+    it."""
+    agent = PeerAgent(_cfg(0, 3, 15916, verification=True))
+    donor = Blockchain(agent.trainer.num_params, num_nodes=3,
+                       default_stake=10)
+    _grow(donor, 8)  # non-empty, signature-less
+    snap = [donor.blocks[0]] + donor.blocks[-4:]
+    assert not agent._adopt_snapshot(list(snap), pruned_weight=4, source=1)
+    reasons = [e.get("reason") for e in agent.tele.recorder.tail(10)
+               if e.get("event") == "snapshot_refused"]
+    assert reasons == ["quorum_unauthenticated"]
+
+
+# ------------------------------------------------------- obs table view
+
+
+def test_obs_membership_column():
+    from biscotti_tpu.tools import obs
+
+    snaps = [
+        {"node": 0, "iter": 5, "membership": {"epoch": 3, "alive": 4,
+                                              "pruned_before": 0},
+         "counters": {"member_join": 2, "member_leave": 1}},
+        {"node": 1, "iter": 5, "membership": {"epoch": 1, "alive": 4,
+                                              "pruned_before": 2},
+         "counters": {"reshare_round": 1}},
+    ]
+    merged = obs.merge_snapshots(snaps)
+    assert merged["membership"]["max_epoch"] == 3
+    assert merged["membership"]["joins"] == 2
+    assert merged["membership"]["leaves"] == 1
+    assert merged["membership"]["reshare_rounds"] == 1
+    table = obs.format_table(merged)
+    assert "epoch" in table and "alive" in table
+    assert "pruned<2" in table
+
+
+# ------------------------------------------------- live: reshare round
+
+
+@pytest.mark.churn
+def test_reshare_round_recovers_after_miner_loss():
+    """ISSUE 8 acceptance (tier-1 shape): a miner hard-killed AFTER share
+    intake bumps the membership epoch and triggers the distributed
+    resharing round — the surviving holders' verified re-deals carry the
+    round to a REAL block where the seed protocol could only mint empty,
+    i.e. at least one successful secure-agg recovery across a resharing
+    epoch."""
+    n, port = 7, 15920
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port, num_miners=3,
+                                 secure_agg=True, verification=True,
+                                 rpc_retries=0, max_iterations=2))
+                  for i in range(n)]
+        tasks = [asyncio.ensure_future(a.run()) for a in agents]
+        a0 = agents[0]
+        # the default pre-election role map has NO miners: wait for the
+        # round-0 election itself, not just the round counter
+        await _wait_until(lambda: len(a0.role_map.committee()[1]) >= 2,
+                          what="round-0 committee election")
+        _, miners, _, _ = a0.role_map.committee()
+        miners = sorted(miners)
+        victim = [m for m in miners if m != max(miners)][0]
+        # condition-driven kill: the moment the victim HOLDS share rows
+        # (it is a live share-holder), tear it down mid-round
+        await _wait_until(
+            lambda: agents[victim].counters.get("secret_registered", 0) >= 1,
+            what="victim to receive share rows")
+        t = tasks[victim]
+        t.cancel()
+        try:
+            await t
+        except BaseException:
+            pass
+        results = await asyncio.gather(
+            *(tasks[i] for i in range(n) if i != victim))
+        return results, victim
+
+    results, victim = asyncio.run(go())
+    merged = {}
+    for r in results:
+        for k, v in r["counters"].items():
+            merged[k] = merged.get(k, 0) + v
+    assert merged.get("miner_lost", 0) >= 1, merged
+    assert merged.get("reshare_round", 0) >= 1, merged
+    assert merged.get("reshare_deal_served", 0) >= 1, merged
+    assert merged.get("reshare_recovered", 0) >= 1, merged
+    # the epoch bump is scrapeable
+    assert any(r["telemetry"]["membership"]["epoch"] >= 1 for r in results)
+    # the recovery produced a real block: some settled block carries
+    # contributions even though a share-holder died mid-round
+    equal, settled, real = surviving_prefix_oracle(results)
+    assert equal, "chains diverged across the resharing epoch"
+    assert real >= 1, results[0]["chain_dump"]
+
+
+# ---------------------------------------------- live: churn schedule run
+
+
+@pytest.mark.churn
+def test_churn_cluster_seeded_schedule_survives():
+    """Live join/leave/rejoin under the seeded schedule (seed 14: one
+    late JOIN, one KILL, one RESTART): the surviving prefix stays equal,
+    real blocks land, membership transitions are observed, and the same
+    churn seed replays the identical timeline."""
+    n, port, rounds = 5, 15940, 8
+    plan = FaultPlan(seed=14, churn=0.25, churn_period=4, churn_down=2)
+    schedule = plan.churn_schedule(n, rounds)
+    kinds = {e.kind for e in schedule}
+    assert kinds == {faults.JOIN, faults.KILL, faults.RESTART}, schedule
+
+    def make(i):
+        return PeerAgent(_cfg(i, n, port, max_iterations=rounds,
+                              verification=True,
+                              breaker_cooldown_s=1.0))
+
+    async def go():
+        runner = ChurnRunner(make, n, schedule)
+        return await runner.run(), runner.events_applied
+
+    results, applied = asyncio.run(go())
+    assert len(results) == n
+    equal, settled, real = surviving_prefix_oracle(results)
+    assert equal, [r["chain_dump"] for r in results]
+    assert settled >= 3, f"no progress under churn: settled={settled}"
+    assert real >= 1, "no real block survived the churn run"
+    # the runner executed the schedule (prefix of it, if the anchor
+    # finished first) in order
+    assert applied == [(e.round, e.node, e.kind)
+                       for e in schedule][:len(applied)]
+    assert applied, "runner applied nothing"
+    # membership transitions were OBSERVED by the survivors
+    joins = sum(r["counters"].get("member_join", 0) for r in results)
+    assert joins >= 1, [r["counters"] for r in results]
+    # replayability: the identical flags yield the identical timeline
+    assert FaultPlan(seed=14, churn=0.25, churn_period=4,
+                     churn_down=2).churn_schedule(n, rounds) == schedule
+
+
+def test_churn_self_kill_exits_cleanly_and_port_is_free():
+    """The peer-side `--fault-churn` executor: a peer whose schedule says
+    KILL at round 1 exits its run() loop cleanly (churned flag, no crash
+    dump) and releases its listen socket synchronously — a relaunched
+    incarnation can bind immediately."""
+    n, port = 2, 15960
+
+    async def go():
+        a0 = PeerAgent(_cfg(0, n, port, max_iterations=4, fedsys=True))
+        a1 = PeerAgent(_cfg(1, n, port, max_iterations=4, fedsys=True))
+        a1._churn_kills = frozenset({1})  # the schedule seam, directly
+        t0 = asyncio.ensure_future(a0.run())
+        r1 = await a1.run()
+        assert r1.get("churned") is True
+        assert r1["iterations"] == 1
+        assert r1["counters"].get("churn_self_kill", 0) == 1
+        # the port is free NOW: a fresh incarnation binds without retry
+        reborn = PeerAgent(_cfg(1, n, port, max_iterations=4, fedsys=True))
+        r1b_task = asyncio.ensure_future(reborn.run())
+        r0 = await t0
+        r1b = await r1b_task
+        return r0, r1, r1b
+
+    r0, r1, r1b = asyncio.run(go())
+    assert r0["iterations"] == 4
+    assert not r1b.get("churned")
+
+
+# ------------------------------------------- live: snapshot bootstrap
+
+
+@pytest.mark.churn
+def test_snapshot_bootstrap_late_joiner_skips_history():
+    """ISSUE 8 acceptance: a late joiner bootstrapping from a snapshot
+    reaches the cluster's round height WITHOUT fetching pre-snapshot
+    blocks — its chain is pruned below the snapshot base, the
+    GetSnapshot reply carries the catch-up bytes, and the RegisterPeer
+    replies stay chain-free (byte accounting)."""
+    n, port, rounds = 4, 15980, 9
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port, max_iterations=rounds,
+                                 verification=True))
+                  for i in range(3)]
+        tasks = [asyncio.ensure_future(a.run()) for a in agents]
+        await _wait_until(lambda: agents[0].iteration >= 6,
+                          what="cluster to build history")
+        late = PeerAgent(_cfg(3, n, port, max_iterations=rounds,
+                              verification=True,
+                              snapshot_bootstrap=True, snapshot_tail=3))
+        ltask = asyncio.ensure_future(late.run())
+        results = await asyncio.gather(*tasks, ltask)
+        return results
+
+    results = asyncio.run(go())
+    late = results[-1]
+    assert late["counters"].get("snapshot_adopted", 0) == 1
+    # reached the cluster's height…
+    assert late["iterations"] == max(r["iterations"] for r in results)
+    # …while never holding (or fetching) the pre-snapshot range
+    assert late["telemetry"]["membership"]["pruned_before"] > 0
+    assert "pruned heights=" in late["chain_dump"]
+    inbound = {}
+    fam = late["telemetry"]["metrics"].get("biscotti_wire_bytes_total", {})
+    for row in fam.get("series", []):
+        labels = row.get("labels", {})
+        if labels.get("direction") == "in":
+            mt = labels["msg_type"]
+            inbound[mt] = inbound.get(mt, 0) + int(row["value"])
+    snap_bytes = inbound.get("GetSnapshot.reply", 0)
+    blk_bytes = inbound.get("GetBlock.reply", 0)
+    reg_bytes = inbound.get("RegisterPeer.reply", 0)
+    assert snap_bytes > 0, inbound
+    # catch-up rode the snapshot, not block pulls or announce bodies
+    assert blk_bytes < snap_bytes, inbound
+    assert reg_bytes < snap_bytes, inbound
+    # the surviving-prefix oracle holds across full + pruned dumps
+    equal, settled, real = surviving_prefix_oracle(results)
+    assert equal and real >= 1
+
+
+# ------------------------------------------------ slow acceptance matrix
+
+
+@pytest.mark.slow
+@pytest.mark.churn
+def test_churn_acceptance_20pct_turnover_defense_intact():
+    """The ISSUE 8 defining run, sized for CI: 20% membership turnover
+    per 10 rounds on a secure-agg + verification cluster with 30%
+    poisoners under FOOLSGOLD — surviving-prefix chains equal, real
+    blocks minted, the same churn seed replays the identical schedule,
+    and the settled defense verdict (which poisoned sources, if any,
+    ever entered a block accepted) is unchanged vs the no-churn run on
+    the same seed."""
+    n, rounds = 8, 12
+    plan = FaultPlan(seed=15, churn=0.2, churn_period=6, churn_down=2)
+    schedule = plan.churn_schedule(n, rounds)
+    assert schedule, "operating point produced no churn"
+
+    def make_cfg(i, port, snap):
+        return _cfg(i, n, port, num_miners=2, secure_agg=True,
+                    verification=True, max_iterations=rounds,
+                    rpc_retries=1, poison_fraction=0.3,
+                    defense="FOOLSGOLD",
+                    snapshot_bootstrap=snap, snapshot_tail=4)
+
+    def accepted_poisoned(anchor_agent):
+        from biscotti_tpu.parallel.sim import _poisoned_ids
+
+        poisoned = _poisoned_ids(n, 0.3)
+        assert poisoned, "poison operating point empty"
+        return {u.source_id
+                for b in anchor_agent.chain.blocks
+                for u in b.data.deltas
+                if u.accepted and u.source_id in poisoned}
+
+    async def churn_run():
+        made = {}
+
+        def make(i):
+            made[i] = PeerAgent(make_cfg(i, 15990, snap=True))
+            return made[i]
+
+        runner = ChurnRunner(make, n, schedule)
+        results = await runner.run()
+        return results, made[0]
+
+    async def plain_run():
+        agents = [PeerAgent(make_cfg(i, 15870, snap=False))
+                  for i in range(n)]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents[0]
+
+    churn_results, churn_anchor = asyncio.run(churn_run())
+    equal, settled, real = surviving_prefix_oracle(churn_results)
+    assert equal, [r["chain_dump"] for r in churn_results]
+    assert settled >= rounds // 2 and real >= 1
+    assert FaultPlan(seed=15, churn=0.2, churn_period=6,
+                     churn_down=2).churn_schedule(n, rounds) == schedule
+
+    plain_results, plain_anchor = asyncio.run(plain_run())
+    pequal, _, preal = surviving_prefix_oracle(plain_results)
+    assert pequal and preal >= 1
+
+    # defense verdict parity on the settled ledgers: churn must not have
+    # smuggled a poisoned source past FoolsGold that the no-churn run
+    # kept out (the id-determined poisoner set is the same in both runs)
+    assert accepted_poisoned(churn_anchor) == accepted_poisoned(
+        plain_anchor)
