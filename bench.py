@@ -344,6 +344,74 @@ def bench_config(name, cfg, device_iters=10, metrics=None):
     return name, row, total
 
 
+def bench_peer_density(sizes=(100, 400, 1000), iterations=2,
+                       budget_s=900.0):
+    """Scale-frontier entry (ISSUE 9): LIVE hive-hosted clusters at
+    N ∈ {100, 400, 1000} — real protocol rounds over the loopback
+    transport with the batched device plane (runtime/hive.py), not a
+    simulator row. Reports s/iter, peak RSS per co-hosted peer, and the
+    chain-equality verdict, so BENCH_r*.json tracks the density frontier
+    alongside the flagship round time. Each size runs as a subprocess
+    (its RSS peak must be its own, not the bench driver's); a failed or
+    timed-out size yields an error row, never a sunk bench.
+
+    Set BISCOTTI_BENCH_DENSITY=0 to skip (e.g. memory-constrained CI)."""
+    import subprocess
+
+    if os.environ.get("BISCOTTI_BENCH_DENSITY", "1") == "0":
+        return {"skipped": "BISCOTTI_BENCH_DENSITY=0"}
+    out = {}
+    deadline = time.time() + budget_s
+    for n in sizes:
+        name = f"n{n}"
+        budget = deadline - time.time()
+        if budget < 30.0:
+            out[name] = {"error": "density budget exhausted"}
+            continue
+        _progress(f"peer_density: N={n} live hive "
+                  f"({iterations} iterations)")
+        cmd = [sys.executable, "-m", "biscotti_tpu.runtime.hive",
+               "-t", str(n), "-d", "mnist",
+               "--iterations", str(iterations),
+               "-sa", "0", "-np", "0", "-vp", "1", "--seed", "3"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        try:
+            proc = subprocess.run(
+                cmd, cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=env, capture_output=True, text=True, timeout=budget)
+            # one parser for the hive summary format (pod_launch is the
+            # other consumer — shared so the two can't drift)
+            from biscotti_tpu.tools.pod_launch import hive_summary
+
+            s = hive_summary(proc.stdout)
+            if s is None:
+                # died before printing its summary (OOM-kill is the
+                # expected failure mode at N=1000): record the exit code
+                # and the stderr tail, or the density row is undebuggable
+                out[name] = {"error": f"no summary (rc={proc.returncode})",
+                             "stderr_tail": proc.stderr[-800:]}
+                _progress(f"peer_density: N={n} failed rc="
+                          f"{proc.returncode}")
+                continue
+            out[name] = {
+                "peers": s["peers"],
+                "blocks": s["blocks"],
+                "chains_equal": s["chains_equal_local"],
+                "s_per_iter": s["s_per_iter"],
+                "rss_peak_mb": round(s["rss_peak_bytes"] / 2**20, 1),
+                "rss_per_peer_mb": round(
+                    s["rss_per_peer_bytes"] / 2**20, 2),
+                "loop_lag_s": s["loop_lag_s"],
+            }
+            _progress(f"peer_density: N={n} {s['s_per_iter']}s/iter, "
+                      f"{out[name]['rss_per_peer_mb']}MB/peer, "
+                      f"chains_equal={s['chains_equal_local']}")
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            _progress(f"peer_density: N={n} failed: {out[name]['error']}")
+    return out
+
+
 def main():
     import jax
 
@@ -417,12 +485,17 @@ def main():
             # row as round_total_s for the r02–r05 trajectory
             headline_total = row["round_total_pipelined_s"]
 
+    # scale frontier: live hive-hosted peer density (one box, real
+    # rounds) — the number the hive runtime exists to move
+    density = bench_peer_density()
+
     detail = {
         "device": str(jax.devices()[0]),
         "data_note": ("synthetic Gaussian shards at reference dimensions "
                       "(zero-egress env): timings comparable, error columns "
                       "not"),
         "configs": rows,
+        "peer_density": density,
     }
     # Full per-config detail goes to a file + stderr; stdout carries exactly
     # ONE compact JSON line so the driver's parser always succeeds
@@ -458,6 +531,11 @@ def main():
         "serial_s_per_iter": serial_total,
         "vs_baseline": (round(BASELINE_MNIST_S_PER_ITER / headline_total, 2)
                         if headline_total else None),
+        # live peer-density frontier (hive runtime, runtime/hive.py):
+        # s/iter + per-peer RSS at N ∈ {100,400,1000} co-hosted on this
+        # box, chains verified equal — tracks the scale wall, not just
+        # the flagship round
+        "peer_density": density,
     }
     print(json.dumps(out))
     return 0

@@ -111,12 +111,39 @@ def _compiled_fns(model: Model, mode: str, clip: float, alpha: float,
     return fns
 
 
+# Shared eval-split device arrays: the test and attack splits are
+# IDENTICAL for every peer of a dataset (datasets.load_shard memoizes the
+# numpy, but jnp.asarray re-uploaded a fresh device buffer per Trainer) —
+# co-hosted clusters paid N copies of the same 6 MB test split. Keyed on
+# the dataset name; jax arrays are immutable, so sharing is safe.
+_EVAL_CACHE: dict = {}
+
+
+def _shared_eval_arrays(dataset: str):
+    if dataset not in _EVAL_CACHE:
+        test = ds.load_shard(dataset, f"{dataset}_test")
+        attack = ds.load_shard(dataset, f"{dataset}_digit1")
+        _EVAL_CACHE[dataset] = (
+            jnp.asarray(test["x_test"]), jnp.asarray(test["y_test"]),
+            jnp.asarray(attack["x_test"]), jnp.asarray(attack["y_test"]))
+    return _EVAL_CACHE[dataset]
+
+
 class Trainer:
     """One peer's ML state: shard on device, shared jitted step/metric
-    functions (see _compiled_fns)."""
+    functions (see _compiled_fns).
+
+    `light=True` (the hive runtime's co-hosted mode, runtime/hive.py)
+    skips the per-peer train-shard upload and the DP-noise presample
+    bank: a hive-hosted peer's SGD and noise draws are served by the
+    shared HiveStepper, so duplicating them per agent would only burn
+    the memory budget the hive exists to fit N≥1000 peers into. The
+    eval splits (shared device buffers either way) and the compiled
+    metric functions stay, so test_error / RONI / attack metrics work;
+    private_fun / get_noise / train_error / roni raise loudly."""
 
     def __init__(self, dataset: str, shard: str, cfg=None, model: Model = None,
-                 seed: int = None):
+                 seed: int = None, light: bool = False):
         from biscotti_tpu.config import BiscottiConfig
 
         self.cfg = cfg or BiscottiConfig(dataset=dataset)
@@ -138,15 +165,15 @@ class Trainer:
         # the event loop via asyncio.to_thread.
         self.metrics = None
 
-        shard_data = ds.load_shard(dataset, shard)
-        test = ds.load_shard(dataset, f"{dataset}_test")
-        attack = ds.load_shard(dataset, f"{dataset}_digit1")
-        self.x_train = jnp.asarray(shard_data["x_train"])
-        self.y_train = jnp.asarray(shard_data["y_train"])
-        self.x_test = jnp.asarray(test["x_test"])
-        self.y_test = jnp.asarray(test["y_test"])
-        self.x_attack = jnp.asarray(attack["x_test"])
-        self.y_attack = jnp.asarray(attack["y_test"])
+        self.light = bool(light)
+        if self.light:
+            self.x_train = self.y_train = None
+        else:
+            shard_data = ds.load_shard(dataset, shard)
+            self.x_train = jnp.asarray(shard_data["x_train"])
+            self.y_train = jnp.asarray(shard_data["y_train"])
+        (self.x_test, self.y_test,
+         self.x_attack, self.y_attack) = _shared_eval_arrays(dataset)
 
         self.num_params = self.model.num_params
         base = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), self.seed)
@@ -154,7 +181,9 @@ class Trainer:
         eps_live = (self.cfg.epsilon
                     if self.cfg.noising or self.cfg.dp_in_model else 0.0)
         self.noise_accept_rate = None
-        if self.cfg.dp_mechanism == "mcmc13":
+        if self.light:
+            self.noise_samples = None
+        elif self.cfg.dp_mechanism == "mcmc13":
             # Song&Sarwate'13 branch (ref: client_obj.py:44-57); served
             # through the same noise_at/get_noise surface as the Gaussian
             self.noise_samples, acc = dp_noise.mcmc_presample(
@@ -184,7 +213,16 @@ class Trainer:
         """Zero init, matching the genesis global model (ref: block.go:46-52)."""
         return np.zeros(self.num_params, dtype=np.float64)
 
+    def _require_full(self, what: str) -> None:
+        if self.light:
+            raise RuntimeError(
+                f"Trainer(light=True) holds no {what}: the hive's shared "
+                "stepper serves SGD/noise for co-hosted peers "
+                "(runtime/hive.py); construct a full Trainer for "
+                "per-agent dispatch")
+
     def private_fun(self, flat_w: np.ndarray, iteration: int) -> np.ndarray:
+        self._require_full("train shard")
         if self.metrics is not None:
             self.metrics.counter("biscotti_trainer_steps_total",
                                  "local SGD steps computed").inc()
@@ -197,6 +235,7 @@ class Trainer:
         )
 
     def get_noise(self, iteration: int) -> np.ndarray:
+        self._require_full("noise bank")
         if self.metrics is not None:
             self.metrics.counter("biscotti_noise_draws_total",
                                  "DP noise vectors served/consumed").inc()
@@ -207,6 +246,7 @@ class Trainer:
         )
 
     def train_error(self, flat_w: np.ndarray) -> float:
+        self._require_full("train shard")
         return float(self._err_fn(jnp.asarray(flat_w, jnp.float32),
                                   self.x_train, self.y_train))
 
@@ -234,6 +274,7 @@ class Trainer:
         return float(jnp.mean((pred == target).astype(jnp.float32)))
 
     def roni(self, flat_w: np.ndarray, delta: np.ndarray) -> float:
+        self._require_full("train shard")
         return float(self._roni_fn(jnp.asarray(flat_w, jnp.float32),
                                    jnp.asarray(delta, jnp.float32),
                                    self.x_train, self.y_train))

@@ -33,7 +33,11 @@ def presample(key: jax.Array, epsilon: float, delta: float, batch_size: int,
     """Return samples[iters, d] ~ Σ_batch σ·N(0,1) (ref: client_obj.py:63-66)."""
     s = sigma_for(epsilon, delta)
     if s == 0.0:
-        return jnp.zeros((expected_iters, d), jnp.float32)
+        # one all-zero row suffices: noise_at indexes `i % iters`, so the
+        # bank is all zeros either way — materializing [iters, d] of zeros
+        # per peer cost ~3 MB × N agents in co-hosted clusters (the hive's
+        # per-peer memory account made it visible)
+        return jnp.zeros((1, d), jnp.float32)
     return s * math.sqrt(batch_size) * jax.random.normal(
         key, (expected_iters, d), jnp.float32
     )

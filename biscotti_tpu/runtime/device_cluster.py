@@ -33,6 +33,33 @@ from typing import Dict, Optional
 import numpy as np
 
 
+async def single_flight_memo(cache: Dict, pending: Dict, key, compute):
+    """Single-flight async memo shared by the batched device planes
+    (BatchStepper here, hive.HiveStepper): the first caller computes
+    off-loop, every concurrent waiter receives the VALUE from the future
+    itself (never a post-await cache re-read — another peer far enough
+    ahead may evict the key between set_result and a waiter resuming),
+    and a failed compute raises in every caller. Returns
+    (value, computed_here)."""
+    if key in cache:
+        return cache[key], False
+    if key in pending:
+        return await pending[key], False
+    fut = asyncio.get_running_loop().create_future()
+    pending[key] = fut
+    try:
+        val = await asyncio.to_thread(compute)
+    except BaseException as e:
+        fut.set_exception(e)
+        fut.exception()  # mark retrieved if nobody is waiting
+        del pending[key]
+        raise
+    cache[key] = val
+    fut.set_result(val)
+    del pending[key]
+    return val, True
+
+
 class BatchStepper:
     """Round-batched sharded SGD: all peers' deltas in one XLA call.
 
@@ -122,28 +149,7 @@ class BatchStepper:
         self.evals = 0  # distinct metric computations (observability/tests)
 
     async def _memo(self, cache: Dict, pending: Dict, key, compute):
-        """Single-flight async memo: the first caller computes off-loop,
-        every concurrent waiter receives the VALUE from the future itself
-        (never a post-await cache re-read — another peer far enough ahead
-        may evict the key between set_result and a waiter resuming), and
-        a failed compute raises in every caller."""
-        if key in cache:
-            return cache[key], False
-        if key in pending:
-            return await pending[key], False
-        fut = asyncio.get_running_loop().create_future()
-        pending[key] = fut
-        try:
-            val = await asyncio.to_thread(compute)
-        except BaseException as e:
-            fut.set_exception(e)
-            fut.exception()  # mark retrieved if nobody is waiting
-            del pending[key]
-            raise
-        cache[key] = val
-        fut.set_result(val)
-        del pending[key]
-        return val, True
+        return await single_flight_memo(cache, pending, key, compute)
 
     async def step(self, peer_id: int, w: np.ndarray, it: int) -> np.ndarray:
         """This peer's delta for iteration `it`; the first caller computes

@@ -1,0 +1,745 @@
+"""Hive runtime: one process hosts H (hundreds of) lightweight co-hosted
+peers that share a single JAX client — the single-box scale wall breaker
+(ROADMAP item 1; docs/HIVE.md).
+
+The one-agent-per-peer runtime tops out around N=400 on one box: every
+peer is a full asyncio agent with its own JAX dispatch, its own TCP hop
+for every frame, and its own copy of every shared tensor (test split,
+DP-noise bank). The hive keeps the agents — the full protocol state
+machine, committees, chain, crypto — but shares everything an honest
+co-hosted deployment can share:
+
+  * **Batched device plane** (`HiveStepper`): within a round, all
+    co-hosted workers' local SGD steps run as ONE vmapped (or, over a
+    mesh, shard_map'd) XLA call — the `parallel/sim.py` round-step math
+    with the `device_cluster.BatchStepper` executor pattern — and DP
+    noise draws coalesce into one [H, d] device draw per round instead
+    of H presample banks of [iters, d].
+  * **Loopback transport fast path** (`LoopbackHub`): RPC between two
+    peers in the same hive skips TCP framing AND serialization — the
+    destination handler receives read-only views of the caller's
+    arrays (the wire plane is bit-exact by design, docs/WIRE_PLANE.md,
+    so skipping the encode changes no value a receiver observes).
+    Admission control, the seeded fault plane, and byte accounting all
+    still apply: the pool draws each frame's fault fate exactly as it
+    would for TCP (chaos replay schedules are unchanged), the
+    destination's `AdmissionController` budgets each delivery (shed →
+    the same retryable BusyError), and the would-be frame size lands in
+    `biscotti_wire_bytes_total` under a new `loopback` direction.
+  * **Shared memory** — light trainers (models/trainer.py `light=True`):
+    co-hosted agents hold no per-peer train shard or noise bank; eval
+    splits are process-wide device buffers; a gossiped block's arrays
+    are aliased (read-only) by every co-hosted chain instead of being
+    re-decoded H times.
+
+Cross-hive traffic — anything toward a peer the hub does not host —
+rides the ordinary TCP wire plane with its negotiated codecs, so a
+cluster of hives spread across processes/hosts (tools/pod_launch.py
+`--peers-per-host`) interoperates frame-for-frame with standalone
+agents.
+
+Launcher CLI (one hive = one process; pod_launch spreads many):
+
+    python -m biscotti_tpu.runtime.hive -t 1000 --local 0:1000 \
+        -d mnist --iterations 3 -sa 0 -np 0 -vp 1
+
+Prints one JSON line: local chain digests (the cross-hive equality
+oracle compares anchors across processes), s/iter, and the honest
+per-peer memory account (peak RSS / peers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import math
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from biscotti_tpu.runtime import codecs as wcodecs
+from biscotti_tpu.runtime.rpc import BusyError, RPCError, StaleError
+
+LOOPBACK = "loopback"  # wire-plane direction label for in-process frames
+
+LOOPBACK_RPCS_METRIC = "biscotti_loopback_rpcs_total"
+LOOPBACK_RPCS_HELP = "RPCs delivered over the in-process loopback fast path"
+LOOPBACK_SECONDS_METRIC = "biscotti_loopback_rpc_seconds"
+LOOPBACK_SECONDS_HELP = "loopback reply-bearing RPC latency"
+
+
+def _ro_view(a) -> np.ndarray:
+    """Read-only ndarray view — loopback delivery must preserve the TCP
+    path's invariant that a receiver cannot mutate what it was handed
+    (frames decode to non-writable frombuffer views); here the arrays
+    ALIAS the sender's memory, so the invariant is load-bearing."""
+    arr = np.asarray(a)
+    v = arr.view()
+    v.flags.writeable = False
+    return v
+
+
+def _frame_estimate(meta, arrays) -> int:
+    """Bytes this RPC WOULD have cost on the wire (raw64 frame: JSON
+    header + raw array payloads + framing) — the loopback direction's
+    byte accounting counts avoided traffic honestly rather than zero,
+    so bytes/round comparisons between co-hosted and remote layouts
+    stay meaningful."""
+    n = 64
+    try:
+        n += len(json.dumps(meta or {}, separators=(",", ":"),
+                            default=str))
+    except (TypeError, ValueError):
+        n += 256
+    for a in (arrays or {}).values():
+        n += np.asarray(a).nbytes
+    return n
+
+
+def rss_bytes() -> int:
+    """Current resident set size of this process (Linux /proc; 0 when
+    unavailable)."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def rss_peak_bytes() -> int:
+    """Peak resident set size (ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
+# --------------------------------------------------------------- transport
+
+
+class LoopbackEndpoint:
+    """One co-hosted peer's in-process RPC surface. Alive exactly while
+    the peer's TCP server would accept a connection (same lifecycle —
+    a closed peer's loopback callers fall back to TCP and get the
+    connection-refused the protocol already handles)."""
+
+    def __init__(self, hub: "LoopbackHub", agent):
+        self.hub = hub
+        self.agent = agent
+
+    @property
+    def alive(self) -> bool:
+        return self.agent.server.serving
+
+    # -------------------------------------------------------- delivery
+
+    async def _dispatch(self, msg_type: str, meta, arrays, src):
+        """One delivered frame: admission-budgeted, handler-dispatched,
+        typed-error mapped exactly as rpc.RPCServer._dispatch would
+        surface it to a TCP caller."""
+        agent = self.agent
+        if not self.alive:
+            raise ConnectionError("loopback endpoint closed")
+        # budget key parity with RPCServer._admit_key: the TCP path keys
+        # on the connection peername (unspoofable); in-process the
+        # caller's identity is the pool that delivered the frame — just
+        # as unspoofable, and per-peer like an honest pooled connection
+        key = ("loop", src)
+        reason = agent.admission.try_admit(key, msg_type)
+        if reason is not None:
+            raise BusyError(f"admission shed: {reason}")
+        try:
+            meta2 = dict(meta or {})
+            arrays2 = {k: _ro_view(v) for k, v in (arrays or {}).items()}
+            try:
+                return await agent._handle(msg_type, meta2, arrays2)
+            except (StaleError, BusyError, RPCError):
+                raise
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # handler bug: report, don't kill the caller — the TCP
+                # server wraps this identically
+                raise RPCError(
+                    f"internal: {type(e).__name__}: {e}") from e
+        finally:
+            agent.admission.release(key)
+
+    def _deliver_bg(self, msg_type, meta, arrays, src,
+                    budget: float) -> None:
+        """Background delivery for fire-and-forget posts and injected
+        duplicate/flood copies: result and errors are discarded, exactly
+        like a TCP frame whose reply nobody awaits."""
+
+        async def go():
+            try:
+                await asyncio.wait_for(
+                    self._dispatch(msg_type, meta, arrays, src),
+                    max(0.001, budget))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+
+        self.hub.track(asyncio.get_running_loop().create_task(go()))
+
+    def _account(self, metrics, msg_type: str, kind: str, meta,
+                 arrays) -> None:
+        if metrics is None:
+            return
+        metrics.counter(wcodecs.WIRE_BYTES_METRIC,
+                        wcodecs.WIRE_BYTES_HELP).inc(
+            _frame_estimate(meta, arrays), msg_type=msg_type,
+            direction=LOOPBACK, codec=wcodecs.RAW)
+        metrics.counter(LOOPBACK_RPCS_METRIC, LOOPBACK_RPCS_HELP).inc(
+            msg_type=msg_type, kind=kind)
+
+    # ------------------------------------------------------ public API
+
+    async def call(self, msg_type: str, meta, arrays, timeout: float,
+                   fault=None, src=None, metrics=None):
+        """Reply-bearing RPC over the fast path. Fault semantics mirror
+        the _Conn boundary: reset → ConnectionError, delay → sleep,
+        drop → the caller's deadline expires (the handler never runs),
+        duplicate/flood → extra deliveries whose replies are dropped."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        # counted regardless of an injected drop — the TCP path counts
+        # outbound bytes once the transport accepted the frame, and an
+        # injected drop still paid the send
+        self._account(metrics, msg_type, "call", meta, arrays)
+        if fault is not None and not fault.benign:
+            if fault.reset:
+                raise ConnectionError("fault injection: connection reset")
+            if fault.delay_s > 0.0:
+                await asyncio.sleep(min(fault.delay_s, timeout))
+            if fault.drop:
+                # frame lost before delivery: the caller waits out its
+                # budget exactly as a TCP timeout would
+                await asyncio.sleep(max(0.001, deadline - loop.time()))
+                raise asyncio.TimeoutError(
+                    "fault injection: frame dropped")
+            extra = (1 if fault.duplicate else 0) + max(0, fault.flood)
+            for _ in range(extra):
+                self._deliver_bg(msg_type, meta, arrays, src,
+                                 deadline - loop.time())
+        t0 = loop.time()
+        task = loop.create_task(self._dispatch(msg_type, meta, arrays,
+                                               src))
+        self.hub.track(task)
+        try:
+            rmeta, rarrays = await asyncio.wait_for(
+                asyncio.shield(task), max(0.001, deadline - loop.time()))
+        except asyncio.TimeoutError:
+            # the handler keeps running, like an abandoned TCP reply —
+            # its state transitions (a registered update, a parked wait)
+            # must not be lost to the caller's impatience
+            raise
+        if metrics is not None:
+            metrics.histogram(LOOPBACK_SECONDS_METRIC,
+                              LOOPBACK_SECONDS_HELP).observe(
+                loop.time() - t0, msg_type=msg_type)
+        # reply accounting on the CALLEE's registry (the TCP server
+        # counts its outbound reply the same way); arrays go back as
+        # read-only views too — the caller must not be able to mutate
+        # the callee's chain through an aliased GetBlock body
+        self._account(self.agent.server.metrics, msg_type + ".reply",
+                      "reply", rmeta, rarrays)
+        return dict(rmeta), {k: _ro_view(v)
+                             for k, v in (rarrays or {}).items()}
+
+    async def post(self, msg_type: str, meta, arrays, timeout: float,
+                   fault=None, src=None, metrics=None) -> None:
+        """Fire-and-forget over the fast path (rid-0 semantics: replies
+        and handler errors are dropped)."""
+        loop = asyncio.get_running_loop()
+        self._account(metrics, msg_type, "post", meta, arrays)
+        if fault is not None and not fault.benign:
+            if fault.reset:
+                raise ConnectionError("fault injection: connection reset")
+            if fault.delay_s > 0.0:
+                await asyncio.sleep(min(fault.delay_s, timeout))
+            if fault.drop:
+                return  # frame lost before delivery (still counted)
+            extra = (1 if fault.duplicate else 0) + max(0, fault.flood)
+            for _ in range(extra):
+                self._deliver_bg(msg_type, meta, arrays, src, timeout)
+        self._deliver_bg(msg_type, meta, arrays, src, timeout)
+
+
+class LoopbackHub:
+    """Per-process registry of co-hosted peers, attached to each member
+    agent's `rpc.Pool` (`pool.loopback`). Lookup is by the (host, port)
+    the CLUSTER addresses the peer with, so remote peers simply miss and
+    ride TCP; a registered peer whose server is not (yet / anymore)
+    serving also misses, so startup races and teardown degrade to the
+    exact connection-refused behavior the retry/breaker plane already
+    handles. Re-registering an id (a relaunched incarnation) replaces
+    the endpoint."""
+
+    def __init__(self):
+        self._by_addr: Dict[Tuple[str, int], LoopbackEndpoint] = {}
+        self._tasks: set = set()
+
+    def register(self, agent) -> LoopbackEndpoint:
+        ep = LoopbackEndpoint(self, agent)
+        self._by_addr[tuple(agent.peers[agent.id])] = ep
+        return ep
+
+    def lookup(self, host: str, port: int) -> Optional[LoopbackEndpoint]:
+        ep = self._by_addr.get((host, port))
+        return ep if ep is not None and ep.alive else None
+
+    @property
+    def local_ids(self) -> frozenset:
+        return frozenset(ep.agent.id for ep in self._by_addr.values())
+
+    def track(self, task: asyncio.Task) -> None:
+        """Strong ref for background deliveries (the loop only keeps
+        weak ones) + exception retrieval on completion."""
+        self._tasks.add(task)
+        task.add_done_callback(self._done)
+
+    def _done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if not task.cancelled():
+            task.exception()  # mark retrieved
+
+
+# ------------------------------------------------------------ device plane
+
+
+class UnequalShardsError(ValueError):
+    """Co-hosted peers' train shards disagree on row count, so one
+    vmapped minibatch draw cannot reproduce each standalone Trainer's
+    `sample_batch(key, own_rows, batch)` stream. Hive catches this and
+    falls back to per-agent trainers (slower, exact)."""
+
+
+class HiveStepper:
+    """Batched device plane for a hive's LOCAL peer subset: all co-hosted
+    workers' SGD deltas in one vmapped XLA call per (iteration, weights),
+    DP noise as one [H, d] draw per iteration, and the shared
+    convergence metric — the `device_cluster.BatchStepper` executor
+    pattern generalized to host a SLICE of the cluster (multi-host
+    hives) with Trainer-parity randomness.
+
+    Key derivation matches models/trainer.Trainer exactly — per peer
+    `fold_in(PRNGKey(cfg.seed), pid)` split into (noise, batch) keys,
+    minibatch key `fold_in(batch_key, it)` — so a hive-hosted peer's
+    SGD stream is the same stream its standalone agent would draw
+    (deltas agree to float tolerance; the vmapped reduction order is
+    the only difference). Noise draws are generated per round
+    (`fold_in(noise_key, it)`) instead of indexed from a presample
+    bank: distribution-identical to the bank (the same argument
+    parallel/sim.py makes), O(H·d) resident instead of O(H·iters·d).
+
+    With a multi-device `mesh` whose size divides H, the delta batch
+    runs under shard_map over the peer axis (the make_sharded_round_step
+    data plane); otherwise a single-client vmap."""
+
+    def __init__(self, cfg, local_ids: Sequence[int], mesh=None):
+        import jax
+        import jax.numpy as jnp
+
+        from biscotti_tpu.data import datasets as ds
+        from biscotti_tpu.models.trainer import local_step_fn, sample_batch
+        from biscotti_tpu.models.zoo import model_for_dataset
+        from biscotti_tpu.ops import dp_noise
+        from biscotti_tpu.parallel.sim import _poisoned_ids
+
+        self.cfg = cfg
+        self.local_ids = sorted(int(i) for i in local_ids)
+        self._slot = {pid: i for i, pid in enumerate(self.local_ids)}
+        h = len(self.local_ids)
+
+        model = model_for_dataset(cfg.dataset,
+                                  getattr(cfg, "model_name", ""))
+        self.num_params = model.num_params
+        mode = "sgd" if model.name == "logreg" else "grad"
+        step = local_step_fn(model, mode, clip=cfg.grad_clip,
+                             alpha=cfg.logreg_alpha)
+
+        poisoned = _poisoned_ids(cfg.num_nodes, cfg.poison_fraction)
+        xs, ys = [], []
+        for pid in self.local_ids:
+            shard = ds.load_shard(
+                cfg.dataset, ds.shard_name(cfg.dataset, pid,
+                                           pid in poisoned))
+            xs.append(shard["x_train"])
+            ys.append(shard["y_train"])
+        sizes = {len(x) for x in xs}
+        if len(sizes) > 1:
+            # truncating to a common row count would change which rows
+            # sample_batch can draw vs the peer's standalone Trainer —
+            # the parity this class promises. Hive falls back to
+            # per-agent trainers when it catches this.
+            raise UnequalShardsError(
+                f"co-hosted shards have unequal row counts {sorted(sizes)}; "
+                "batched stepping would break Trainer-parity sampling")
+        rows = sizes.pop()
+        self._x = jnp.asarray(np.stack(xs))
+        self._y = jnp.asarray(np.stack(ys))
+        batch = min(cfg.batch_size, rows)
+
+        # Trainer-parity per-peer key streams (see class docstring)
+        bases = [jax.random.fold_in(jax.random.PRNGKey(cfg.seed), pid)
+                 for pid in self.local_ids]
+        pairs = [jax.random.split(b) for b in bases]
+        self._noise_keys = jnp.stack([p[0] for p in pairs])
+        self._batch_keys = jnp.stack([p[1] for p in pairs])
+
+        def one_delta(w, bkey, xi, yi, it):
+            k = jax.random.fold_in(bkey, it)
+            idx = sample_batch(k, rows, batch)
+            return step(w, xi[idx], yi[idx])
+
+        n_dev = 1
+        if mesh is not None:
+            n_dev = math.prod(mesh.devices.shape)
+        if mesh is not None and n_dev > 1 and h % n_dev == 0:
+            # peers-across-devices: the make_sharded_round_step data
+            # plane — each device computes its peer slice, one gather
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from biscotti_tpu.utils.compat import shard_map
+
+            axis = mesh.axis_names[0]
+
+            def local_batch(w, bkeys, x_loc, y_loc, it):
+                return jax.vmap(one_delta,
+                                in_axes=(None, 0, 0, 0, None))(
+                    w, bkeys, x_loc, y_loc, it)
+
+            mapped = shard_map(
+                local_batch, mesh=mesh,
+                in_specs=(P(), P(axis), P(axis), P(axis), P()),
+                out_specs=P(axis), check_vma=False)
+            self._deltas = jax.jit(mapped)
+            sharding = NamedSharding(mesh, P(axis))
+            self._x = jax.device_put(self._x, sharding)
+            self._y = jax.device_put(self._y, sharding)
+            self._batch_keys = jax.device_put(self._batch_keys, sharding)
+        else:
+
+            @jax.jit
+            def _deltas(w, bkeys, x, y, it):
+                return jax.vmap(one_delta,
+                                in_axes=(None, 0, 0, 0, None))(
+                    w, bkeys, x, y, it)
+
+            self._deltas = _deltas
+
+        # DP noise: fresh per-round batched draw, Σ_batch σ·N(0,1)
+        # scaled by −α/batch like trainer.get_noise / sim._peer_noise.
+        # mcmc13 peers keep their per-agent trainer banks (the chain
+        # draw doesn't batch trivially) — serves_noise gates that.
+        eps_live = cfg.epsilon if (cfg.noising or cfg.dp_in_model) else 0.0
+        self._sigma = dp_noise.sigma_for(eps_live, cfg.delta)
+        self._noise_alpha = cfg.logreg_alpha if mode == "sgd" else 1.0
+        # UNCLAMPED batch size, matching Trainer exactly: presample's
+        # sqrt scale and noise_at's 1/batch denominator both use
+        # cfg.batch_size even when the shard is smaller than a batch
+        self._noise_batch = cfg.batch_size
+        self.serves_noise = cfg.dp_mechanism != "mcmc13"
+
+        scale = self._sigma * math.sqrt(cfg.batch_size)
+
+        @jax.jit
+        def _noise(nkeys, it):
+            def one(k):
+                return scale * jax.random.normal(
+                    jax.random.fold_in(k, it), (self.num_params,),
+                    jnp.float32)
+
+            return jax.vmap(one)(nkeys)
+
+        self._noise_fn = _noise
+
+        # shared convergence metric (identical model × identical global
+        # test split — peer.py's uniform-convergence requirement)
+        from biscotti_tpu.models.trainer import _shared_eval_arrays
+
+        self._x_test, self._y_test, _, _ = _shared_eval_arrays(cfg.dataset)
+        self._err_fn = jax.jit(model.error_flat)
+
+        self._caches: Dict[str, Dict] = {"step": {}, "noise": {},
+                                         "eval": {}}
+        self._pending: Dict[str, Dict] = {"step": {}, "noise": {},
+                                          "eval": {}}
+        self.batches = 0  # batched delta dispatches (observability)
+        self.noise_batches = 0
+        self.evals = 0
+
+    async def _memo(self, kind: str, key, compute):
+        from biscotti_tpu.runtime.device_cluster import single_flight_memo
+
+        return await single_flight_memo(self._caches[kind],
+                                        self._pending[kind], key, compute)
+
+    def _evict(self, kind: str, it: int) -> None:
+        cache = self._caches[kind]
+        for old in [k for k in cache
+                    if (k[0] if isinstance(k, tuple) else k) < it - 3]:
+            cache.pop(old, None)
+
+    async def step(self, peer_id: int, w: np.ndarray,
+                   it: int) -> np.ndarray:
+        """This peer's SGD delta for iteration `it`; the first co-hosted
+        caller computes the WHOLE hive's batch. Keyed on (it, weight
+        digest): transiently forked chains compute their own batch,
+        identical chains — the lockstep case — share one."""
+        import jax.numpy as jnp
+
+        wb = np.ascontiguousarray(np.asarray(w))
+        key = (it, hashlib.sha1(wb.tobytes()).hexdigest())
+
+        def compute():
+            return np.asarray(
+                self._deltas(jnp.asarray(wb, jnp.float32),
+                             self._batch_keys, self._x, self._y, it),
+                dtype=np.float64)
+
+        deltas, computed = await self._memo("step", key, compute)
+        if computed:
+            self.batches += 1
+        self._evict("step", it)
+        return deltas[self._slot[peer_id]]
+
+    async def noise(self, peer_id: int, it: int) -> np.ndarray:
+        """This peer's DP noise vector for iteration `it` — one [H, d]
+        device draw per round, shared by every co-hosted noiser."""
+        if self._sigma == 0.0:
+            return np.zeros(self.num_params, np.float64)
+
+        def compute():
+            draw = np.asarray(self._noise_fn(self._noise_keys, it),
+                              dtype=np.float64)
+            return (-self._noise_alpha / self._noise_batch) * draw
+
+        bank, computed = await self._memo("noise", (it,), compute)
+        if computed:
+            self.noise_batches += 1
+        self._evict("noise", it)
+        return bank[self._slot[peer_id]]
+
+    async def test_error(self, w: np.ndarray, it: int) -> float:
+        """Global-test-split error, computed once per distinct
+        (iteration, weights) across the hive."""
+        import jax.numpy as jnp
+
+        wb = np.ascontiguousarray(np.asarray(w))
+        key = (it, hashlib.sha1(wb.tobytes()).hexdigest())
+
+        def compute():
+            return float(self._err_fn(jnp.asarray(wb, jnp.float32),
+                                      self._x_test, self._y_test))
+
+        err, computed = await self._memo("eval", key, compute)
+        if computed:
+            self.evals += 1
+        self._evict("eval", it)
+        return err
+
+
+# ----------------------------------------------------------------- launcher
+
+
+class Hive:
+    """One hive: H co-hosted `PeerAgent`s sharing a LoopbackHub, a
+    HiveStepper, and one event loop. `local_ids` names the slice of the
+    cluster this process hosts (default: all of it — the single-box
+    density configuration); the peers file / base-port arithmetic in
+    `cfg_base` must describe the WHOLE cluster so cross-hive addresses
+    resolve.
+
+    Co-hosted peers are made mutually known at construction (caps +
+    liveness), so a genesis hive launch skips the O(H²) intra-hive
+    hello storm; hellos toward REMOTE peers still run, which is how a
+    late-started hive adopts the cluster's chain."""
+
+    def __init__(self, cfg_base, local_ids: Optional[Sequence[int]] = None,
+                 mesh=None, key_dir: str = "", log_dir: str = "",
+                 hive_id: str = "", batch_device: bool = True,
+                 loopback: bool = True, skip_local_announce: bool = True):
+        from biscotti_tpu.runtime.peer import PeerAgent
+
+        self.cfg = cfg_base
+        self.local_ids = sorted(local_ids if local_ids is not None
+                                else range(cfg_base.num_nodes))
+        # loopback=False / batch_device=False are the ablation knobs the
+        # density bench A/Bs against: full agents talking real TCP in one
+        # process — exactly the pre-hive one-agent-per-peer runtime
+        self.hub = LoopbackHub() if loopback else None
+        self.stepper = None
+        self.stepper_fallback = ""
+        if batch_device:
+            try:
+                self.stepper = HiveStepper(cfg_base, self.local_ids,
+                                           mesh=mesh)
+            except UnequalShardsError as e:
+                # exactness beats batching: per-agent trainers keep the
+                # standalone sampling streams when shards are unequal
+                self.stepper_fallback = str(e)
+        light = self.stepper is not None and self.stepper.serves_noise
+        # shared mutable per-hive readout: the monitor task updates it,
+        # every member's telemetry_snapshot()["hive"] reads it, the obs
+        # CLI groups the cluster table by its id (docs/OBSERVABILITY.md)
+        self.info: Dict = {
+            "id": hive_id or f"pid{os.getpid()}",
+            "peers": len(self.local_ids),
+            "rss_bytes": 0, "rss_peak_bytes": 0, "loop_lag_s": 0.0,
+        }
+        self.agents: List[PeerAgent] = []
+        for pid in self.local_ids:
+            cfg = cfg_base.replace(node_id=pid)
+            self.agents.append(PeerAgent(
+                cfg, key_dir=key_dir, stepper=self.stepper,
+                hive=self.hub, light_trainer=light,
+                log_path=os.path.join(log_dir, f"events_{pid}.jsonl")
+                if log_dir else ""))
+        caps = sorted(self.agents[0].caps) if self.agents else []
+        local_set = frozenset(self.local_ids)
+        for a in self.agents:
+            a.hive_info = self.info
+            if skip_local_announce:
+                a._announce_skip = local_set
+            for pid in self.local_ids:
+                if pid != a.id:
+                    a._record_caps(pid, caps)
+
+    async def _monitor(self, period: float = 0.25) -> None:
+        """Event-loop lag + RSS sampler: co-hosting starvation must be
+        VISIBLE (an overloaded hive's lag gauge climbs), not inferred
+        from round-time anomalies."""
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(period)
+            self.info["loop_lag_s"] = round(
+                max(0.0, loop.time() - t0 - period), 4)
+            self.info["rss_bytes"] = rss_bytes()
+            self.info["rss_peak_bytes"] = rss_peak_bytes()
+
+    async def run(self) -> List[Dict]:
+        mon = asyncio.get_running_loop().create_task(self._monitor())
+        try:
+            return await asyncio.gather(*(a.run() for a in self.agents))
+        finally:
+            mon.cancel()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from biscotti_tpu.config import BiscottiConfig, Defense
+
+    ap = argparse.ArgumentParser(
+        description="hive host: co-hosted lightweight peers, one process")
+    BiscottiConfig.add_args(ap)
+    ap.add_argument("--local", default="",
+                    help="START:COUNT slice of node ids this hive hosts "
+                         "(default: the whole cluster)")
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--key-dir", default="")
+    ap.add_argument("--log-dir", default="")
+    ap.add_argument("--hive-id", default="")
+    ap.add_argument("--no-batch-device", action="store_true",
+                    help="ablation: per-agent trainer dispatch instead of "
+                         "the hive's batched device plane")
+    ap.add_argument("--no-loopback", action="store_true",
+                    help="ablation: co-hosted peers talk real TCP (the "
+                         "pre-hive one-agent-per-peer runtime)")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform (site hooks may otherwise pin an "
+                         "accelerator; the hive's batch is CPU/TPU "
+                         "agnostic)")
+    ap.add_argument("--dump-chain", action="store_true",
+                    help="also print the anchor agent's full chain dump")
+    ns = ap.parse_args(argv)
+
+    os.environ["JAX_PLATFORMS"] = ns.platform
+    import jax
+
+    jax.config.update("jax_platforms", ns.platform)
+    jax.config.update("jax_enable_x64", True)
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(repo, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    cfg = BiscottiConfig.from_args(ns)
+    cfg = cfg.replace(
+        max_iterations=ns.iterations, convergence_error=0.0,
+        timeouts=cfg.timeouts.scaled(
+            cfg.num_nodes, cfg.num_verifiers, cfg.num_miners,
+            random_sampling=cfg.random_sampling,
+            defense_is_krum=cfg.defense == Defense.KRUM))
+    local = None
+    if ns.local:
+        start, count = (int(x) for x in ns.local.split(":"))
+        local = range(start, start + count)
+
+    try:  # large hives need many sockets: lift the soft fd limit
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except Exception:
+        pass
+
+    hive = Hive(cfg, local, key_dir=ns.key_dir, log_dir=ns.log_dir,
+                hive_id=ns.hive_id, batch_device=not ns.no_batch_device,
+                loopback=not ns.no_loopback)
+    t0 = time.time()
+    results = asyncio.run(hive.run())
+    wall = time.time() - t0
+
+    dumps = [r["chain_dump"] for r in results]
+    digests = [hashlib.sha256(d.encode()).hexdigest() for d in dumps]
+    anchor = results[0]
+    rows = [tuple(x.split(",")) for x in anchor["logs"]]
+    if len(rows) >= 2:
+        ts = [float(r[2]) for r in rows]
+        s_per_iter = (ts[-1] - ts[0]) / (len(ts) - 1)
+    else:
+        s_per_iter = wall / max(1, ns.iterations)
+    peak = rss_peak_bytes()
+    summary = {
+        "hive": hive.info["id"],
+        "nodes": [hive.local_ids[0], hive.local_ids[-1] + 1],
+        "peers": len(hive.local_ids),
+        "blocks": len(dumps[0].splitlines()) - 1,
+        "chains_equal_local": all(d == digests[0] for d in digests),
+        "chain_digest": digests[0],
+        "wall_s": round(wall, 2),
+        "s_per_iter": round(s_per_iter, 4),
+        "rss_peak_bytes": peak,
+        "rss_per_peer_bytes": int(peak / max(1, len(hive.local_ids))),
+        "loop_lag_s": hive.info["loop_lag_s"],
+        # reflects reality, not the flag: unequal co-hosted shards fall
+        # back to per-agent trainers (UnequalShardsError) and must not
+        # masquerade as a batched run in the bench artifact
+        "batch_device": hive.stepper is not None,
+        "batch_fallback": hive.stepper_fallback or None,
+        "loopback": not ns.no_loopback,
+        "sgd_batches": hive.stepper.batches if hive.stepper else None,
+        "final_error": anchor.get("final_error"),
+    }
+    if ns.dump_chain:
+        print("=== CHAIN DUMP ===")
+        print(dumps[0])
+        print("=== LOGS ===")
+    print(json.dumps(summary))
+    return 0 if summary["chains_equal_local"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -179,11 +179,12 @@ class RoundState:
 class PeerAgent:
     def __init__(self, cfg: BiscottiConfig, key_dir: str = "",
                  log_path: str = "", ckpt_dir: str = "", ckpt_every: int = 10,
-                 stepper=None):
+                 stepper=None, hive=None, light_trainer: bool = False):
         self.cfg = cfg
-        # peers-as-devices mode: a shared BatchStepper computes ALL local
-        # peers' SGD deltas in one sharded XLA call per round
-        # (runtime/device_cluster.py); None = per-agent trainer dispatch
+        # peers-as-devices mode: a shared BatchStepper (or the hive's
+        # HiveStepper) computes ALL local peers' SGD deltas in one
+        # batched XLA call per round (runtime/device_cluster.py,
+        # runtime/hive.py); None = per-agent trainer dispatch
         self.stepper = stepper
         self.ckpt_dir = ckpt_dir
         self.ckpt_every = max(1, ckpt_every)
@@ -193,7 +194,11 @@ class PeerAgent:
 
         poisoned = _poisoned_ids(cfg.num_nodes, cfg.poison_fraction)
         shard = ds.shard_name(cfg.dataset, self.id, self.id in poisoned)
-        self.trainer = Trainer(cfg.dataset, shard, cfg=cfg, seed=self.id)
+        # light trainers (hive co-hosting) hold no per-peer train shard
+        # or noise bank — the shared stepper serves both; eval splits and
+        # metric fns remain (models/trainer.py docstring)
+        self.trainer = Trainer(cfg.dataset, shard, cfg=cfg, seed=self.id,
+                               light=light_trainer)
         self.chain = Blockchain(self.trainer.num_params, cfg.num_nodes,
                                 cfg.default_stake)
 
@@ -341,6 +346,21 @@ class PeerAgent:
         # reply-codec capability set for the RPC server: callers request
         # a reply codec via `acodec`, granted iff inside OUR caps
         self.server.caps = self.caps
+        # hive co-hosting (runtime/hive.py, docs/HIVE.md): register with
+        # the process-local LoopbackHub and attach it to the pool, so
+        # RPCs toward co-hosted peers skip TCP framing and serialization
+        # while still flowing through the fault draw, the destination's
+        # admission controller, and the wire byte counters. `hive_info`
+        # is the hive's shared readout dict (peers, RSS, loop lag),
+        # surfaced under telemetry_snapshot()["hive"]; `_announce_skip`
+        # names co-hosted peers made mutually known at construction, so
+        # a genesis hive launch skips the O(H²) intra-hive hello storm.
+        self.hive_info: Optional[Dict] = None
+        self._announce_skip: frozenset = frozenset()
+        if hive is not None:
+            hive.register(self)
+            self.pool.loopback = hive
+            self.pool.loopback_src = self.id
         self._metrics_server = None
         self._rng = random.Random(cfg.seed * 7919 + self.id)
         # strong refs to fire-and-forget tasks: the loop only keeps weak
@@ -498,6 +518,11 @@ class PeerAgent:
             # whatever it actually holds
             "recorder": {"events": getattr(self.tele.recorder, "_seq", 0),
                          "wrapped": self.tele.recorder.wrapped},
+            # hive co-hosting readout (runtime/hive.py): the shared
+            # per-hive dict (id, co-hosted peer count, RSS, event-loop
+            # lag) the obs CLI groups its per-host columns by. None for
+            # a standalone agent.
+            "hive": dict(self.hive_info) if self.hive_info else None,
         }
 
     async def _h_metrics(self, meta, arrays):
@@ -851,6 +876,16 @@ class PeerAgent:
             self.noise_vrf, self.chain.latest_stake_map(),
             self.chain.latest_hash(), self.id, self.cfg.num_noisers,
             self.cfg.num_nodes)
+
+    async def _own_noise(self, it: int) -> np.ndarray:
+        """This peer's DP noise vector for `it` — from the per-agent
+        presample bank, or (hive co-hosting with a light trainer) from
+        the shared stepper's batched per-round draw. Deterministic per
+        (peer, iteration) either way, so a noiser serves the same vector
+        on every request for a round."""
+        if self.trainer.light:
+            return await self.stepper.noise(self.id, it)
+        return self.trainer.get_noise(it)
 
     # ---------------------------------------------------------- RPC surface
 
@@ -1361,9 +1396,21 @@ class PeerAgent:
             # the block (it is a push they need to advance), but a peer
             # shedding load is not first in line for a multi-MB frame
             targets = targets + busy_targets
+            # hive loopback partition (runtime/hive.py): co-hosted targets
+            # get the SAME block object via post_direct — no frame encode
+            # at all, the dominant broadcast cost — while remote targets
+            # share one encode per codec group as before. The partition is
+            # re-checked at send time inside push(): a co-hosted peer that
+            # died in between gets the ConnectionError a closed TCP socket
+            # would raise, never a silent drop.
+            loopback_pids = frozenset(
+                pid for pid in targets
+                if self.pool.loopback_endpoint(*self.peers[pid]) is not None)
             frames: Dict[Tuple[str, int], Tuple[bytes, str]] = {}
             group: Dict[int, Tuple[str, int]] = {}
             for pid in targets:
+                if pid in loopback_pids:
+                    continue
                 key = self._wire_to(pid)
                 group[pid] = key
                 if key not in frames:
@@ -1381,12 +1428,17 @@ class PeerAgent:
 
             async def push(pid):
                 host, port = self.peers[pid]
-                frame, eff = frames[group[pid]]
                 try:
-                    await self.pool.post(host, port, frame,
-                                         timeout=self.timeouts.rpc_s,
-                                         msg_type="RegisterBlock",
-                                         codec=eff)
+                    if pid in loopback_pids:
+                        await self.pool.post_direct(
+                            host, port, "RegisterBlock", meta, arrays,
+                            timeout=self.timeouts.rpc_s)
+                    else:
+                        frame, eff = frames[group[pid]]
+                        await self.pool.post(host, port, frame,
+                                             timeout=self.timeouts.rpc_s,
+                                             msg_type="RegisterBlock",
+                                             codec=eff)
                 except Exception:
                     self.alive.discard(pid)
                     self._record_peer_fail(pid)
@@ -2111,7 +2163,7 @@ class PeerAgent:
         if not ok:
             self._trace("noise_draw_rejected", source=sid)
             raise RPCError("noiser lottery proof failed verification")
-        noise = self.trainer.get_noise(it)
+        noise = await self._own_noise(it)
         return {}, {"noise": noise}
 
     async def _h_verify_update(self, meta, arrays):
@@ -2614,7 +2666,7 @@ class PeerAgent:
 
         noise = None
         if cfg.dp_in_model:
-            delta = delta + self.trainer.get_noise(it)
+            delta = delta + await self._own_noise(it)
         if self.wire.lossy:
             # lossy-before-commit (docs/WIRE_PLANE.md): project the delta
             # onto the codec's representable set NOW — the quantization,
@@ -3249,8 +3301,12 @@ class PeerAgent:
             except Exception:
                 pass
 
+        # co-hosted peers (hive mode) were made mutually known — caps +
+        # liveness — at construction; REMOTE peers still get the hello,
+        # which is how a late-started hive adopts the cluster's chain
         await asyncio.gather(*(one(pid) for pid in sorted(self.peers)
-                               if pid != self.id))
+                               if pid != self.id
+                               and pid not in self._announce_skip))
 
     async def run(self) -> Dict:
         # resume from the newest on-disk snapshot, then let longest-chain

@@ -408,6 +408,13 @@ class RPCServer:
                     raise
                 await asyncio.sleep(0.2)
 
+    @property
+    def serving(self) -> bool:
+        """True while the listen socket would accept a connection — the
+        hive's loopback endpoints share this lifecycle (a closed peer's
+        co-hosted callers must get connection-refused, not delivery)."""
+        return self._server is not None and self._server.is_serving()
+
     def close_now(self) -> None:
         """Synchronous teardown: release the LISTENING socket immediately
         and cancel live handlers, without awaiting wait_closed(). For
@@ -777,6 +784,16 @@ class Pool:
         # ports, a persistent pooled connection could otherwise squat on
         # a port a co-hosted peer needs to bind (see open_frame_stream)
         self.avoid_local_ports: frozenset = frozenset()
+        # Hive loopback fast path (runtime/hive.py, docs/HIVE.md): when a
+        # LoopbackHub is attached, calls/posts toward a CO-HOSTED peer
+        # skip TCP framing and serialization entirely — the hub delivers
+        # (meta, arrays) straight into the destination's handler, still
+        # flowing through this pool's fault-plane draw, the destination's
+        # admission controller, and the wire byte counters (a `loopback`
+        # direction). `loopback_src` is the owning peer's id (the hub
+        # keys admission budgets and fault schedules on it).
+        self.loopback = None
+        self.loopback_src: Optional[int] = None
 
     def _evict(self, exempt: Optional[Tuple[str, int]] = None) -> None:
         # drop dead connections regardless of the cap, then close idle
@@ -863,6 +880,19 @@ class Pool:
                 await asyncio.sleep(d)
         fault = (self.faults.action(host, port, msg_type, attempt)
                  if self.faults is not None else None)
+        if self.loopback is not None:
+            # co-hosted destination: deliver in-process (the fault draw
+            # above already consumed this frame's schedule slot, so a
+            # chaos run's per-link fate sequence is identical either way)
+            ep = self.loopback.lookup(host, port)
+            if ep is not None:
+                # remaining budget, not the full timeout: the latency
+                # sleep above already spent part of the one deadline
+                # that covers dial + send + reply (TCP-path contract)
+                return await ep.call(msg_type, meta, arrays,
+                                     max(0.001, deadline - loop.time()),
+                                     fault=fault, src=self.loopback_src,
+                                     metrics=self.metrics)
         m = self.metrics
         t0 = loop.time()
         try:
@@ -922,6 +952,20 @@ class Pool:
                 await asyncio.sleep(d / 2)  # one-way: no reply to wait for
         fault = (self.faults.action(host, port, msg_type, attempt)
                  if self.faults is not None else None)
+        if self.loopback is not None:
+            ep = self.loopback.lookup(host, port)
+            if ep is not None:
+                # pre-encoded frame toward a co-hosted peer (a caller
+                # that didn't partition targets first): decode once and
+                # deliver in-process — the encode is sunk, the TCP hop
+                # and the receiver's decode/admission-peek copies aren't.
+                # Broadcast paths avoid even the encode via post_direct.
+                mt, dmeta, darrays = msgs.decode(frame)
+                await ep.post(mt, dmeta, darrays,
+                              max(0.001, deadline - loop.time()),
+                              fault=fault, src=self.loopback_src,
+                              metrics=self.metrics)
+                return
         conn = await self._get(host, port, timeout)
         if self.metrics is not None:
             self.metrics.counter("biscotti_rpc_frames_total",
@@ -932,6 +976,41 @@ class Pool:
                 len(frame), msg_type=msg_type, direction="out", codec=codec)
         await conn._send(frame, max(0.001, deadline - loop.time()),
                          fault=fault)
+
+    def loopback_endpoint(self, host: str, port: int):
+        """The co-hosted endpoint for (host, port), or None when the
+        target is remote / not currently serving — broadcast paths use
+        this to partition targets so co-hosted peers never pay the frame
+        encode at all (runtime/hive.py)."""
+        if self.loopback is None:
+            return None
+        return self.loopback.lookup(host, port)
+
+    async def post_direct(self, host: str, port: int, msg_type: str,
+                          meta: Dict[str, Any] | None = None,
+                          arrays: Dict[str, np.ndarray] | None = None,
+                          timeout: float = 120.0, attempt: int = 0) -> None:
+        """Fire-and-forget toward a CO-HOSTED peer without any
+        serialization: the hive broadcast fast path (gossip pushes the
+        same block object to every local peer; remote peers get the
+        encoded frame via `post`). Raises ConnectionError when the
+        target is not loopback-local — callers partition targets with
+        `loopback_endpoint` first, and a peer that died in between gets
+        the same transport failure a closed TCP socket would raise."""
+        ep = self.loopback_endpoint(host, port)
+        if ep is None:
+            raise ConnectionError(f"{host}:{port} is not loopback-local")
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        if self.latency is not None:
+            d = self.latency(host, port)
+            if d > 0:
+                await asyncio.sleep(d / 2)
+        fault = (self.faults.action(host, port, msg_type, attempt)
+                 if self.faults is not None else None)
+        await ep.post(msg_type, meta, arrays,
+                      max(0.001, deadline - loop.time()), fault=fault,
+                      src=self.loopback_src, metrics=self.metrics)
 
     def close(self) -> None:
         for conn in self._conns.values():

@@ -81,8 +81,12 @@ def merge_wire(snaps: List[Dict]) -> Dict:
     """Merge every peer's `biscotti_wire_bytes_total` counters into one
     cluster traffic table: totals per direction, outbound split by codec
     and by message type. Outbound is the attribution axis (summing both
-    directions would double-count every loopback frame)."""
-    out = {"out_bytes": 0, "in_bytes": 0,
+    directions would double-count every loopback-socket frame).
+    `loopback_bytes` counts frames between co-hosted hive peers
+    (runtime/hive.py) at their would-be raw64 size — traffic the fast
+    path AVOIDED; without it a fully co-hosted cluster reads "out 0B"
+    and the layout comparison the accounting exists for goes dark."""
+    out = {"out_bytes": 0, "in_bytes": 0, "loopback_bytes": 0,
            "out_by_codec": {}, "out_by_msg_type": {}}
     for snap in snaps:
         fam = (snap.get("metrics") or {}).get("biscotti_wire_bytes_total")
@@ -99,6 +103,8 @@ def merge_wire(snaps: List[Dict]) -> Dict:
                     out["out_by_msg_type"].get(mt, 0) + v
             elif labels.get("direction") == "in":
                 out["in_bytes"] += v
+            elif labels.get("direction") == "loopback":
+                out["loopback_bytes"] += v
     return out
 
 
@@ -129,6 +135,39 @@ def merge_admission(snaps: List[Dict]) -> Dict:
             mt = row.get("labels", {}).get("msg_type", "?")
             out["shed_by_msg_type"][mt] = \
                 out["shed_by_msg_type"].get(mt, 0) + int(row.get("value", 0))
+    return out
+
+
+def merge_hives(snaps: List[Dict]) -> Dict[str, Dict]:
+    """Per-host hive table (runtime/hive.py, docs/HIVE.md): every
+    co-hosted peer's snapshot carries its hive's shared readout under
+    `hive`; peers of one hive all reference the SAME dict, so rows
+    collapse by hive id. Columns make co-hosting starvation VISIBLE:
+    co-hosted peer count, RSS per peer, and the event-loop lag gauge —
+    an overloaded hive shows a climbing lag, not just slow rounds."""
+    out: Dict[str, Dict] = {}
+    for snap in snaps:
+        h = snap.get("hive")
+        if not h:
+            continue
+        hid = str(h.get("id", "?"))
+        row = out.setdefault(hid, {
+            "peers_cohosted": int(h.get("peers", 0)),
+            "scraped": 0,
+            "rss_bytes": int(h.get("rss_bytes", 0)),
+            "rss_peak_bytes": int(h.get("rss_peak_bytes", 0)),
+            "loop_lag_s": float(h.get("loop_lag_s", 0.0)),
+        })
+        row["scraped"] += 1
+        # a later snapshot of the same hive may carry fresher samples
+        row["rss_bytes"] = max(row["rss_bytes"], int(h.get("rss_bytes", 0)))
+        row["rss_peak_bytes"] = max(row["rss_peak_bytes"],
+                                    int(h.get("rss_peak_bytes", 0)))
+        row["loop_lag_s"] = max(row["loop_lag_s"],
+                                float(h.get("loop_lag_s", 0.0)))
+    for row in out.values():
+        row["rss_per_peer_bytes"] = int(
+            row["rss_peak_bytes"] / max(1, row["peers_cohosted"]))
     return out
 
 
@@ -194,6 +233,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "counters": counters,
         "wire": wire,
         "admission": merge_admission(snaps),
+        "hives": merge_hives(snaps),
         "phases": merge_phase_histograms(snaps),
         "per_node": per_node,
     }
@@ -224,14 +264,18 @@ def format_table(merged: Dict) -> str:
                      f"{n.get('alive', 0):>6} {n['breaker_opens']:>6} "
                      f"{n['fast_fails']:>8}  {' '.join(extra)}")
     wire = merged.get("wire") or {}
-    if wire.get("out_bytes") or wire.get("in_bytes"):
+    if (wire.get("out_bytes") or wire.get("in_bytes")
+            or wire.get("loopback_bytes")):
         by_codec = ", ".join(
             f"{k}={_fmt_bytes(v)}"
             for k, v in sorted(wire["out_by_codec"].items(),
                                key=lambda kv: -kv[1]))
+        lb = wire.get("loopback_bytes", 0)
         lines += ["", f"wire: out {_fmt_bytes(wire['out_bytes'])}  "
                       f"in {_fmt_bytes(wire['in_bytes'])}  "
                       f"({_fmt_bytes(wire.get('bytes_per_round', 0))}/round)"
+                      + (f"   loopback {_fmt_bytes(lb)} avoided"
+                         if lb else "")
                       + (f"   [{by_codec}]" if by_codec else "")]
     adm = merged.get("admission") or {}
     if adm.get("enabled_peers") or adm.get("shed_total"):
@@ -242,6 +286,16 @@ def format_table(merged: Dict) -> str:
                       + f"   inflight peak {adm['inflight_peak']}"
                       f"   parked peak {adm['parked_peak']}"
                       f"   [{adm['enabled_peers']} peers enforcing]"]
+    hives = merged.get("hives") or {}
+    if hives:
+        lines += ["", f"{'hive':<16} {'peers':>6} {'scraped':>8} "
+                      f"{'rss':>9} {'rss/peer':>9} {'looplag':>8}"]
+        for hid, h in sorted(hives.items()):
+            lines.append(
+                f"{hid:<16} {h['peers_cohosted']:>6} {h['scraped']:>8} "
+                f"{_fmt_bytes(h['rss_peak_bytes']):>9} "
+                f"{_fmt_bytes(h['rss_per_peer_bytes']):>9} "
+                f"{h['loop_lag_s']:>8.4f}")
     if merged["faults"]:
         lines += ["", "injected faults (cluster): " + ", ".join(
             f"{k}={v}" for k, v in sorted(merged["faults"].items()))]

@@ -55,8 +55,61 @@ def committee_size(requested: int, total: int) -> int:
     """Clamp a committee size so small fleets keep vanilla WORKERS: the
     config's reference defaults (3 miners + 3 verifiers) would otherwise
     swallow every node of a 4-peer fleet — zero updates, all-empty
-    blocks (the launcher's original silent failure mode)."""
+    blocks (the launcher's original silent failure mode). Large fleets
+    (hive mode reaches N≥1000) pass through untouched below total//3."""
     return max(1, min(requested, total // 3))
+
+
+def hive_cmd(args, start, count, total, peers_file, hive_id,
+             bind_ip="127.0.0.1"):
+    """One HIVE process hosting `count` co-hosted peers (runtime/hive.py,
+    --peers-per-host mode): the single-process-per-peer model tops out
+    around N=400 on one box; a hive per host carries hundreds of
+    lightweight peers on one JAX client + loopback transport."""
+    cmd = [sys.executable, "-m", "biscotti_tpu.runtime.hive",
+           "-t", str(total),
+           "-d", args.dataset, "-f", peers_file,
+           "-a", bind_ip,
+           "-p", str(args.base_port),
+           "-sa", str(args.secure_agg), "-np", str(args.noising),
+           "-vp", str(args.verification),
+           "-na", str(committee_size(args.num_miners, total)),
+           "-nv", str(committee_size(args.num_verifiers, total)),
+           "-nn", str(committee_size(args.num_noisers, total)),
+           "--iterations", str(args.iterations),
+           "--seed", str(args.seed),
+           "--local", f"{start}:{count}",
+           "--hive-id", hive_id]
+    if args.key_dir:
+        cmd += ["--key-dir", args.key_dir]
+    return cmd
+
+
+def hive_summary(text):
+    """The hive launcher's one-line JSON summary (last JSON line of its
+    stdout), or None when the process died before printing it."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def cross_hive_equal(summaries):
+    """THE cross-host chain-equality smoke check for hive mode: every
+    hive's LOCAL chains must agree (chains_equal_local) AND every hive's
+    anchor digest must match hive 0's — per-process output alone cannot
+    see a cross-hive fork."""
+    if not summaries or any(s is None for s in summaries):
+        return False
+    if not all(s.get("chains_equal_local") for s in summaries):
+        return False
+    ref = summaries[0].get("chain_digest")
+    return bool(ref) and all(s.get("chain_digest") == ref
+                             for s in summaries)
 
 
 def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
@@ -83,6 +136,11 @@ def main(argv=None) -> int:
                     help="file with one host per line; 'localhost' runs "
                          "in-place, anything else becomes an ssh command")
     ap.add_argument("--nodes-per-host", type=int, default=5)
+    ap.add_argument("--peers-per-host", type=int, default=0,
+                    help="hive mode: ONE process per host co-hosting this "
+                         "many lightweight peers (runtime/hive.py) instead "
+                         "of nodes-per-host full agent processes — the "
+                         "single-box scale wall breaker (docs/HIVE.md)")
     ap.add_argument("--dataset", default="mnist")
     ap.add_argument("--base-port", type=int, default=23500)
     ap.add_argument("--iterations", type=int, default=5)
@@ -107,8 +165,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     hosts = read_hosts(args.hosts)
-    total = len(hosts) * args.nodes_per_host
-    write_peers_file(hosts, args.nodes_per_host, args.base_port,
+    per_host = args.peers_per_host or args.nodes_per_host
+    total = len(hosts) * per_host
+    write_peers_file(hosts, per_host, args.base_port,
                      args.peers_file)
 
     # distribute the bootstrap artifacts to every remote host (the
@@ -134,34 +193,44 @@ def main(argv=None) -> int:
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
 
+    def launch(key, h, cmd):
+        if h == "localhost":
+            if args.dry_run:
+                print(f"[local] {' '.join(map(shlex.quote, cmd))}")
+                return
+            procs.append((key, subprocess.Popen(
+                cmd, cwd=REPO, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)))
+        else:
+            remote = (f"cd {shlex.quote(REPO)} && JAX_PLATFORMS=cpu "
+                      f"{' '.join(map(shlex.quote, cmd))}")
+            ssh = [*shlex.split(args.ssh_cmd), h, remote]
+            if args.dry_run:
+                print(f"[ssh]   {' '.join(map(shlex.quote, ssh))}")
+            else:
+                procs.append((key, subprocess.Popen(
+                    ssh, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True)))
+
     procs = []
     node_id = 0
-    for h in hosts:
-        for _ in range(args.nodes_per_host):
-            bind_ip = "127.0.0.1" if h == "localhost" else "0.0.0.0"
-            cmd = peer_cmd(args, node_id, total, args.peers_file, bind_ip)
-            if h == "localhost":
-                if args.dry_run:
-                    print(f"[local] {' '.join(map(shlex.quote, cmd))}")
-                else:
-                    procs.append((node_id, subprocess.Popen(
-                        cmd, cwd=REPO, env=env,
-                        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                        text=True)))
-            else:
-                remote = (f"cd {shlex.quote(REPO)} && JAX_PLATFORMS=cpu "
-                          f"{' '.join(map(shlex.quote, cmd))}")
-                ssh = [*shlex.split(args.ssh_cmd), h, remote]
-                if args.dry_run:
-                    print(f"[ssh]   {' '.join(map(shlex.quote, ssh))}")
-                else:
-                    procs.append((node_id, subprocess.Popen(
-                        ssh, stdout=subprocess.PIPE,
-                        stderr=subprocess.DEVNULL, text=True)))
-            node_id += 1
+    for hi, h in enumerate(hosts):
+        bind_ip = "127.0.0.1" if h == "localhost" else "0.0.0.0"
+        if args.peers_per_host:
+            # hive mode: one process per HOST, co-hosting per_host peers
+            launch(hi, h, hive_cmd(args, node_id, per_host, total,
+                                   args.peers_file, f"hive{hi}", bind_ip))
+            node_id += per_host
+        else:
+            for _ in range(per_host):
+                launch(node_id, h, peer_cmd(args, node_id, total,
+                                            args.peers_file, bind_ip))
+                node_id += 1
     if args.dry_run:
         print(json.dumps({"dry_run": True, "total_nodes": total,
                           "hosts": len(hosts),
+                          "hive_mode": bool(args.peers_per_host),
                           "peers_file": args.peers_file}))
         return 0
 
@@ -175,6 +244,29 @@ def main(argv=None) -> int:
             p.kill()
             out, _ = p.communicate()
         outs[nid] = out or ""
+
+    if args.peers_per_host:
+        # hive mode: every hive prints one JSON summary; the smoke check
+        # is cross_hive_equal — local equality per hive AND one digest
+        # across hives (a cross-hive fork is invisible per-process)
+        summaries = [hive_summary(outs.get(hi, "")) for hi in
+                     range(len(hosts))]
+        equal = cross_hive_equal(summaries)
+        ok = [s for s in summaries if s]
+        summary = {
+            "total_nodes": total, "hosts": len(hosts),
+            "hive_mode": True, "peers_per_host": per_host,
+            "chains_equal": equal,
+            "blocks": ok[0].get("blocks", 0) if ok else 0,
+            "s_per_iter": max((s.get("s_per_iter", 0.0) for s in ok),
+                              default=None),
+            "rss_per_peer_bytes": max(
+                (s.get("rss_per_peer_bytes", 0) for s in ok),
+                default=None),
+            "hives": ok,
+        }
+        print(json.dumps(summary))
+        return 0 if equal else 1
 
     def chain_of(text):
         lines = text.splitlines()

@@ -40,6 +40,7 @@ def test_dry_run_plans_scp_ssh_and_local(tmp_path, capsys):
     assert "[ssh]" in out and "0.0.0.0" in out
     summary = json.loads(out.splitlines()[-1])
     assert summary == {"dry_run": True, "total_nodes": 2, "hosts": 2,
+                       "hive_mode": False,
                        "peers_file": str(tmp_path / "peers.txt")}
 
 
@@ -68,3 +69,109 @@ def test_remote_branch_executes_end_to_end_via_sshim(tmp_path, capsys):
     assert summary["chains_equal"] is True
     assert summary["total_nodes"] == 4
     assert summary["blocks"] >= 1
+
+
+# ------------------------------------------------------------- hive mode
+
+
+def test_hive_mode_dry_run_one_process_per_host(tmp_path, capsys):
+    """--peers-per-host flips the launcher into hive mode: ONE process
+    per host co-hosting many lightweight peers (runtime/hive.py), with
+    the peers file still describing the WHOLE cluster so cross-hive
+    addresses resolve."""
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("localhost\nvm-a\n")
+    keys = tmp_path / "keys"
+    keys.mkdir()
+    rc = pod_launch.main([
+        "--hosts", str(hosts), "--peers-per-host", "50",
+        "--dataset", "creditcard", "--iterations", "1",
+        "--key-dir", str(keys),
+        "--peers-file", str(tmp_path / "peers.txt"), "--dry-run",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    launches = [ln for ln in out.splitlines()
+                if ln.startswith(("[local]", "[ssh]"))]
+    assert len(launches) == 2, launches  # one PROCESS per host, not 50
+    assert all("biscotti_tpu.runtime.hive" in ln for ln in launches)
+    # each hive hosts its contiguous START:COUNT slice of the id space
+    assert "--local 0:50" in launches[0]
+    assert "--local 50:50" in launches[1]
+    summary = json.loads(out.splitlines()[-1])
+    assert summary == {"dry_run": True, "total_nodes": 100, "hosts": 2,
+                       "hive_mode": True,
+                       "peers_file": str(tmp_path / "peers.txt")}
+    # the peers file covers all 100 ids (cross-hive dialing)
+    assert len((tmp_path / "peers.txt").read_text().splitlines()) == 100
+
+
+def test_hive_cmd_exercises_committee_size_at_n1000(tmp_path):
+    """committee_size must keep behaving at hive-scale N: requested
+    committees pass through untouched below total//3, oversized requests
+    clamp, and the N=1000 hive command carries the clamped values."""
+    assert pod_launch.committee_size(3, 1000) == 3
+    assert pod_launch.committee_size(333, 1000) == 333
+    assert pod_launch.committee_size(500, 1000) == 333  # clamped
+    assert pod_launch.committee_size(3, 4) == 1         # small fleets too
+    ns = type("A", (), dict(
+        dataset="mnist", base_port=23500, secure_agg=0, noising=0,
+        verification=1, num_miners=500, num_verifiers=3, num_noisers=3,
+        iterations=2, seed=3, key_dir=""))()
+    cmd = pod_launch.hive_cmd(ns, 0, 1000, 1000, "peers.txt", "hive0")
+    assert cmd[cmd.index("-t") + 1] == "1000"
+    assert cmd[cmd.index("-na") + 1] == "333"   # clamped at N=1000
+    assert cmd[cmd.index("-nv") + 1] == "3"     # passthrough
+    assert cmd[cmd.index("--local") + 1] == "0:1000"
+
+
+def test_cross_hive_equality_oracle():
+    """The hive-mode smoke check must see what per-process output
+    cannot: a fork BETWEEN hives whose local chains each agree."""
+    a = {"chains_equal_local": True, "chain_digest": "aaa"}
+    b = {"chains_equal_local": True, "chain_digest": "aaa"}
+    forked = {"chains_equal_local": True, "chain_digest": "bbb"}
+    split = {"chains_equal_local": False, "chain_digest": "aaa"}
+    assert pod_launch.cross_hive_equal([a, b])
+    assert not pod_launch.cross_hive_equal([a, forked])   # cross-hive fork
+    assert not pod_launch.cross_hive_equal([a, split])    # intra-hive fork
+    assert not pod_launch.cross_hive_equal([a, None])     # dead hive
+    assert not pod_launch.cross_hive_equal([])
+    assert not pod_launch.cross_hive_equal(
+        [{"chains_equal_local": True}])                   # digest missing
+
+
+def test_hive_summary_parses_last_json_line():
+    text = "warmup noise\n{broken\n" + json.dumps(
+        {"peers": 3, "chain_digest": "abc"}) + "\ntrailer"
+    assert pod_launch.hive_summary(text) == {"peers": 3,
+                                             "chain_digest": "abc"}
+    assert pod_launch.hive_summary("no json here") is None
+
+
+def test_hive_mode_live_two_hives_cross_process_chains_equal(tmp_path,
+                                                             capsys):
+    """Hive mode end-to-end (tier-1): two REAL hive processes on this
+    box, three co-hosted peers each, cross-hive traffic over real TCP —
+    the launcher's smoke check must verify chain equality ACROSS hives,
+    not just per-process."""
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("localhost\nlocalhost\n")
+    rc = pod_launch.main([
+        "--hosts", str(hosts), "--peers-per-host", "3",
+        "--dataset", "creditcard", "--iterations", "2",
+        "--base-port", "27720",
+        "--peers-file", str(tmp_path / "peers.txt"),
+        "--timeout", "240",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    summary = json.loads(out.splitlines()[-1])
+    assert summary["hive_mode"] is True
+    assert summary["total_nodes"] == 6
+    assert summary["chains_equal"] is True
+    assert summary["blocks"] >= 1
+    assert len(summary["hives"]) == 2
+    digests = {h["chain_digest"] for h in summary["hives"]}
+    assert len(digests) == 1
+    assert all(h["rss_per_peer_bytes"] > 0 for h in summary["hives"])
