@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 # token buckets): safe to import here without dragging the asyncio
 # runtime into config users
 from biscotti_tpu.runtime.admission import AdmissionPlan
-from biscotti_tpu.runtime.faults import FaultPlan
+from biscotti_tpu.runtime.faults import SLOW_PRESETS, FaultPlan
 
 
 class Defense(str, enum.Enum):
@@ -204,6 +204,22 @@ class BiscottiConfig:
     # behavior: admit everything, park without bound).
     admission_plan: AdmissionPlan = field(default_factory=AdmissionPlan)
 
+    # --- straggler-tolerance plane (runtime/stragglers.py,
+    # docs/STRAGGLERS.md) ---
+    # adaptive_deadlines=True arms the per-peer deadline controller AND
+    # partial-quorum graceful degradation: each deadline-bearing phase
+    # (block wait, miner intake, krum timer, worker collection fan-outs)
+    # sets its next budget to clamp(max(EWMA, p95) x margin,
+    # [deadline_floor_s, legacy constant]) from its own observed
+    # durations, and worker fan-outs proceed once a sufficient quorum is
+    # reached after that soft deadline instead of waiting all-or-timeout
+    # (excluded honest stragglers are counted, never breaker-fed or
+    # stake-debited). Default off = the reference's fixed Timeouts
+    # constants and all-or-timeout collection, bit-identical.
+    adaptive_deadlines: bool = False
+    deadline_margin: float = 1.5
+    deadline_floor_s: float = 1.0
+
     # --- membership plane (runtime/membership.py, docs/MEMBERSHIP.md) ---
     # snapshot_bootstrap=True: a (re)joining peer catches up from a chain
     # SNAPSHOT pulled over the chunked GetSnapshot RPC — genesis hash
@@ -344,6 +360,27 @@ class BiscottiConfig:
             raise ValueError(
                 f"fault_plan.churn={self.fault_plan.churn} must be in "
                 "[0, 1): it is the membership fraction churned per window")
+        # straggler plane: a typo'd preset must fail at construction, not
+        # when the first profile is drawn mid-round; knob sanity likewise
+        if self.fault_plan.slow_preset \
+                and self.fault_plan.slow_preset not in SLOW_PRESETS:
+            raise ValueError(
+                f"fault_plan.slow_preset={self.fault_plan.slow_preset!r} "
+                f"unknown: pick from {SLOW_PRESETS}")
+        if not (0.0 <= self.fault_plan.slow <= 1.0):
+            raise ValueError(
+                f"fault_plan.slow={self.fault_plan.slow} must be in "
+                "[0, 1]: it is the membership fraction assigned a slow "
+                "profile")
+        if self.fault_plan.slow_factor < 1.0:
+            raise ValueError("fault_plan.slow_factor must be >= 1 (it "
+                             "multiplies compute wall-clock)")
+        if self.deadline_margin < 1.0:
+            raise ValueError("deadline_margin must be >= 1: the adaptive "
+                             "deadline is estimate x margin and a margin "
+                             "below 1 guarantees spurious expiry")
+        if self.deadline_floor_s <= 0.0:
+            raise ValueError("deadline_floor_s must be > 0")
         if self.snapshot_tail < 1:
             raise ValueError("snapshot_tail must be >= 1")
 
@@ -501,6 +538,45 @@ class BiscottiConfig:
                        default=FaultPlan.churn_down,
                        help="rounds a churned peer stays down before its "
                             "scheduled restart")
+        p.add_argument("--fault-slow", type=float, default=FaultPlan.slow,
+                       help="fraction of the membership assigned a slow "
+                            "speed profile, seeded draw (the straggler "
+                            "fault kind, docs/STRAGGLERS.md)")
+        p.add_argument("--fault-slow-factor", type=float,
+                       default=FaultPlan.slow_factor,
+                       help="compute-slowdown multiple for drawn slow "
+                            "peers (presets override)")
+        p.add_argument("--fault-slow-service-s", type=float,
+                       default=FaultPlan.slow_service_s,
+                       help="extra per-RPC service delay a slow peer "
+                            "charges every inbound request")
+        p.add_argument("--fault-slow-preset", type=str,
+                       default=FaultPlan.slow_preset,
+                       choices=["", "tee", "bimodal", "longtail"],
+                       help="named speed-profile preset for the drawn "
+                            "subset: tee = the arXiv:2501.11771-"
+                            "calibrated confidential-compute overhead, "
+                            "bimodal = 2x/8x split, longtail = heavy-"
+                            "tail severities")
+        p.add_argument("--fault-slow-node", type=int,
+                       default=FaultPlan.slow_node,
+                       help="pin this node slow regardless of the "
+                            "fraction draw (-1: none)")
+        p.add_argument("--adaptive-deadlines", type=int,
+                       default=int(BiscottiConfig.adaptive_deadlines),
+                       help="1 arms the straggler-tolerance plane: "
+                            "per-phase adaptive round deadlines "
+                            "(EWMA+p95, clamped to the legacy "
+                            "constants) and partial-quorum graceful "
+                            "degradation (docs/STRAGGLERS.md)")
+        p.add_argument("--deadline-margin", type=float,
+                       default=BiscottiConfig.deadline_margin,
+                       help="adaptive deadline = duration estimate x "
+                            "this margin")
+        p.add_argument("--deadline-floor-s", type=float,
+                       default=BiscottiConfig.deadline_floor_s,
+                       help="adaptive deadlines never drop below this "
+                            "floor")
         p.add_argument("--snapshot-bootstrap", type=int,
                        default=int(BiscottiConfig.snapshot_bootstrap),
                        help="1: (re)joining peers catch up from a chain "
@@ -646,6 +722,12 @@ class BiscottiConfig:
             wire_chunk_bytes=getattr(ns, "wire_chunk_bytes",
                                      cls.wire_chunk_bytes),
             wire_topk=getattr(ns, "wire_topk", cls.wire_topk),
+            adaptive_deadlines=bool(getattr(ns, "adaptive_deadlines",
+                                            cls.adaptive_deadlines)),
+            deadline_margin=getattr(ns, "deadline_margin",
+                                    cls.deadline_margin),
+            deadline_floor_s=getattr(ns, "deadline_floor_s",
+                                     cls.deadline_floor_s),
             snapshot_bootstrap=bool(getattr(ns, "snapshot_bootstrap",
                                             cls.snapshot_bootstrap)),
             snapshot_tail=getattr(ns, "snapshot_tail", cls.snapshot_tail),
@@ -667,6 +749,15 @@ class BiscottiConfig:
                                      FaultPlan.churn_period),
                 churn_down=getattr(ns, "fault_churn_down",
                                    FaultPlan.churn_down),
+                slow=getattr(ns, "fault_slow", FaultPlan.slow),
+                slow_factor=getattr(ns, "fault_slow_factor",
+                                    FaultPlan.slow_factor),
+                slow_service_s=getattr(ns, "fault_slow_service_s",
+                                       FaultPlan.slow_service_s),
+                slow_preset=getattr(ns, "fault_slow_preset",
+                                    FaultPlan.slow_preset),
+                slow_node=getattr(ns, "fault_slow_node",
+                                  FaultPlan.slow_node),
             ),
             admission_plan=AdmissionPlan(
                 enabled=bool(getattr(ns, "admission",

@@ -124,6 +124,46 @@ JOIN = "join"
 
 
 @dataclass(frozen=True)
+class SlowProfile:
+    """One peer's speed profile under the `slow` fault kind
+    (docs/STRAGGLERS.md): `compute_factor` multiplies the wall-clock of
+    the peer's heavy compute paths (Trainer/stepper SGD step, worker
+    commitment/share generation, miner intake crypto — emulated by
+    padding each measured segment to factor× its duration), and
+    `service_s` is an extra per-RPC service delay the peer's handler
+    seam charges every inbound request (applied identically by the TCP
+    server dispatch and the hive loopback dispatch, so TCP and
+    co-hosted layouts see the same schedule)."""
+
+    compute_factor: float = 1.0
+    service_s: float = 0.0
+    preset: str = ""
+
+    @property
+    def slowed(self) -> bool:
+        return self.compute_factor > 1.0 or self.service_s > 0.0
+
+
+NO_SLOW = SlowProfile()
+
+# Named speed-profile presets for the drawn slow subset (docs/STRAGGLERS.md):
+#   tee      — confidential-compute peer, calibrated from "Characterization
+#              of GPU TEE Overheads" (arXiv:2501.11771): kernel compute in
+#              TEE mode is near-native (<10%), but encrypted CPU↔GPU bounce
+#              transfers dominate transfer-bound workloads — and a
+#              federated round ships the full model both ways every
+#              iteration, exactly that regime. 4× compute (the paper's
+#              transfer-dominated small-batch penalty band) + 20 ms
+#              per-RPC service latency (encrypted-channel setup per
+#              request).
+#   bimodal  — half the drawn peers mildly slow (2×), half badly (8×):
+#              the two-cluster fleet (e.g. one old GPU generation).
+#   longtail — severity v^-0.7 capped at 16×: most drawn peers are
+#              modestly slow, a few are severe (the volunteer-fleet tail).
+SLOW_PRESETS = ("tee", "bimodal", "longtail")
+
+
+@dataclass(frozen=True)
 class FaultPlan:
     """Seeded link-fault configuration (surfaced as cfg.fault_plan).
 
@@ -167,6 +207,24 @@ class FaultPlan:
     # schedule (drop/delay/dup/reset/flood, keyed on `seed`) stays
     # fixed — chaos `--churn-seed` rides this, never a plan reseed.
     churn_seed: int = -1
+    # slow: fraction of the membership assigned a heterogeneous speed
+    # profile (0 disables) — the straggler fault kind
+    # (docs/STRAGGLERS.md). Which peers are slow, and how slow, is a
+    # pure function of (seed, node) via slow_profile() below, so a
+    # straggler run replays from the flags exactly like drop/flood/
+    # churn — and because the profile is consulted by the PEER (compute
+    # pads + handler service delay), TCP and hive-loopback layouts see
+    # the identical schedule by construction. Unlike churn, node 0 is
+    # drawable: a slow peer still participates honestly.
+    slow: float = 0.0
+    slow_factor: float = 4.0   # compute-slowdown multiple for drawn peers
+    slow_service_s: float = 0.0  # extra per-RPC service delay for them
+    # named preset overriding (slow_factor, slow_service_s) for the
+    # drawn subset: "tee" | "bimodal" | "longtail" (see SLOW_PRESETS)
+    slow_preset: str = ""
+    # pin this node slow regardless of the fraction draw (-1: none) —
+    # the deterministic single-straggler scenario (chaos --slow-node)
+    slow_node: int = -1
 
     @property
     def enabled(self) -> bool:
@@ -179,6 +237,54 @@ class FaultPlan:
     @property
     def churn_enabled(self) -> bool:
         return self.churn > 0.0
+
+    @property
+    def slow_enabled(self) -> bool:
+        """Heterogeneous speed profiles armed? (Not a frame fault: the
+        profile is consumed by the peer's compute pads and handler seam,
+        so a slow-only plan does not pay the per-frame draw.)"""
+        return self.slow > 0.0 or self.slow_node >= 0
+
+    def slow_profile(self, node: int, num_nodes: int) -> SlowProfile:
+        """The deterministic speed profile of `node` — pure in
+        (seed, node), so every peer (and every harness) derives the same
+        fleet table from the flags alone. Membership draw and severity
+        draw are carved from one digest; `slow_node` pins its node into
+        the slow set regardless of the fraction."""
+        if not self.slow_enabled or not (0 <= node < num_nodes):
+            return NO_SLOW
+        h = hashlib.sha256(
+            f"biscotti-slow|{self.seed}|{node}".encode()).digest()
+        u = int.from_bytes(h[:6], "big") / float(1 << 48)
+        if node != self.slow_node and u >= self.slow:
+            return NO_SLOW
+        v = int.from_bytes(h[6:12], "big") / float(1 << 48)
+        preset = self.slow_preset
+        if preset == "tee":
+            factor, service = 4.0, 0.02
+        elif preset == "bimodal":
+            factor, service = (2.0 if v < 0.5 else 8.0), 0.01
+        elif preset == "longtail":
+            factor = min(16.0, max(1.0, max(v, 1e-12) ** -0.7))
+            service = 0.01
+        elif preset:
+            raise ValueError(
+                f"unknown slow_preset {preset!r}: pick from {SLOW_PRESETS}")
+        else:
+            factor = max(1.0, float(self.slow_factor))
+            service = max(0.0, float(self.slow_service_s))
+        return SlowProfile(compute_factor=factor, service_s=service,
+                           preset=preset)
+
+    def slow_table(self, num_nodes: int) -> Dict[int, SlowProfile]:
+        """Every slowed node's profile — the fleet table chaos reports
+        and the obs 'slowest peers' view render."""
+        out: Dict[int, SlowProfile] = {}
+        for n in range(num_nodes):
+            p = self.slow_profile(n, num_nodes)
+            if p.slowed:
+                out[n] = p
+        return out
 
     def churn_schedule(self, num_nodes: int,
                        max_rounds: int) -> List[ChurnEvent]:

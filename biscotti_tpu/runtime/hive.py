@@ -154,6 +154,13 @@ class LoopbackEndpoint:
         if reason is not None:
             raise BusyError(f"admission shed: {reason}")
         try:
+            if agent.server.service_delay_s > 0.0:
+                # slow-peer service emulation, mirrored from
+                # RPCServer._dispatch: a co-hosted slow peer serves its
+                # loopback callers exactly as slowly as its TCP callers —
+                # the layout-invariance the straggler plane promises
+                # (docs/STRAGGLERS.md)
+                await asyncio.sleep(agent.server.service_delay_s)
             meta2 = dict(meta or {})
             arrays2 = {k: _ro_view(v) for k, v in (arrays or {}).items()}
             try:
@@ -475,6 +482,12 @@ class HiveStepper:
         self.batches = 0  # batched delta dispatches (observability)
         self.noise_batches = 0
         self.evals = 0
+        # wall-clock of the last batched SGD dispatch: the straggler
+        # plane's compute pad bases a co-hosted slow peer's padding on
+        # the batch's REAL cost — a memo-hit caller measures ~0 for its
+        # own await, which would otherwise make hive layouts immune to
+        # the slowdown TCP layouts emulate (docs/STRAGGLERS.md)
+        self.step_cost_s = 0.0
 
     async def _memo(self, kind: str, key, compute):
         from biscotti_tpu.runtime.device_cluster import single_flight_memo
@@ -500,10 +513,13 @@ class HiveStepper:
         key = (it, hashlib.sha1(wb.tobytes()).hexdigest())
 
         def compute():
-            return np.asarray(
+            t0 = time.perf_counter()
+            out = np.asarray(
                 self._deltas(jnp.asarray(wb, jnp.float32),
                              self._batch_keys, self._x, self._y, it),
                 dtype=np.float64)
+            self.step_cost_s = time.perf_counter() - t0
+            return out
 
         deltas, computed = await self._memo("step", key, compute)
         if computed:

@@ -51,6 +51,7 @@ from biscotti_tpu.parallel.sim import _poisoned_ids
 from biscotti_tpu.runtime import admission as adm
 from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
+from biscotti_tpu.runtime import stragglers
 from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import BusyError, RPCError, StaleError
 from biscotti_tpu.telemetry import Telemetry, serve_metrics
@@ -303,6 +304,23 @@ class PeerAgent:
         bind_port = (self.peers[self.id][1] if cfg.peers_file
                      else cfg.port_of(self.id))
         self.server = rpc.RPCServer(cfg.my_ip, bind_port, self._handle)
+        # straggler-tolerance plane (runtime/stragglers.py,
+        # docs/STRAGGLERS.md): this peer's seeded speed profile (the
+        # `slow` fault kind — NO_SLOW unless the plan drew us), the
+        # adaptive deadline controller (answers the legacy Timeouts
+        # constants verbatim until armed AND warmed), and the forensics
+        # ledger (waiting-on view, excluded-straggler and stall tallies).
+        # The per-RPC service delay lives on the TRANSPORT seam — the
+        # TCP server dispatch and the hive loopback dispatch both read
+        # server.service_delay_s — so TCP and co-hosted layouts serve
+        # identically slow from one seeded schedule.
+        self.slow = cfg.fault_plan.slow_profile(self.id, cfg.num_nodes)
+        self.server.service_delay_s = self.slow.service_s
+        self.deadlines = stragglers.DeadlineController(
+            enabled=cfg.adaptive_deadlines, margin=cfg.deadline_margin,
+            floor_s=cfg.deadline_floor_s)
+        self.straggler = stragglers.StragglerLedger()
+        self._round_t0 = time.monotonic()
         self.round = RoundState(iteration=self.chain.next_iteration)
         self.role_map = R.RoleMap({i: 1 for i in range(cfg.num_nodes)})
         self.logs: List[Tuple[int, float, float]] = []  # iter, err, ts
@@ -335,6 +353,7 @@ class PeerAgent:
                 self.pool.faults.metrics = self.tele.registry
             self.admission.metrics = self.tele.registry
             self.trainer.metrics = self.tele.registry
+            self.straggler.metrics = self.tele.registry
         # the controller is wired into the server UNCONDITIONALLY so the
         # inflight accounting (and its gauges) is live even in
         # observability-only runs; a DISABLED plan admits everything
@@ -482,6 +501,17 @@ class PeerAgent:
         reg.gauge("biscotti_membership_epoch",
                   "observed membership transitions (join/leave/reshare)"
                   ).set(self.membership_epoch)
+        # straggler plane (docs/STRAGGLERS.md): this peer's emulated
+        # slowdown and the controller's current per-phase deadline
+        # decisions — a scrape shows at a glance whether (and how far)
+        # the fleet has tightened the legacy constants
+        reg.gauge("biscotti_slow_compute_factor",
+                  "this peer's emulated compute-slowdown multiple "
+                  "(1 = unslowed)").set(self.slow.compute_factor)
+        dl = reg.gauge(stragglers.DEADLINE_GAUGE, stragglers.DEADLINE_HELP)
+        for ph, row in self.deadlines.snapshot()["phases"].items():
+            if "deadline_s" in row:
+                dl.set(row["deadline_s"], phase=ph)
 
     def telemetry_snapshot(self) -> Dict:
         """THE public observability readout — one structured dict serving
@@ -513,6 +543,19 @@ class PeerAgent:
             "membership": {"epoch": self.membership_epoch,
                            "alive": len(self.alive),
                            "pruned_before": self.chain.pruned_before},
+            # straggler-tolerance plane (docs/STRAGGLERS.md): this peer's
+            # speed profile, the waiting-on view / excluded + stall
+            # tallies, and the deadline controller's per-phase state —
+            # the obs `waiting-on` column and the chaos `stragglers`
+            # report key read exactly this
+            "stragglers": {
+                "profile": {"compute_factor": self.slow.compute_factor,
+                            "service_s": self.slow.service_s,
+                            "preset": self.slow.preset,
+                            "slowed": self.slow.slowed},
+                **self.straggler.snapshot(),
+                "deadlines": self.deadlines.snapshot(),
+            },
             # the recorder may be real even with telemetry disabled (an
             # explicit spill path keeps the event log alive) — report
             # whatever it actually holds
@@ -886,6 +929,97 @@ class PeerAgent:
         if self.trainer.light:
             return await self.stepper.noise(self.id, it)
         return self.trainer.get_noise(it)
+
+    # ------------------------------------------------- straggler plane
+
+    async def _slow_pad(self, base_s: float) -> None:
+        """Compute-slowdown emulation (docs/STRAGGLERS.md): pad a just-
+        measured compute segment to `compute_factor` x its duration. The
+        pad is an event-loop sleep, so a slow peer's compute takes
+        longer WITHOUT burning host CPU other co-hosted peers need —
+        and because it is derived from the measured duration, chains
+        and protocol bytes are bit-identical to the unslowed run; only
+        the timing changes. No-op for an unslowed profile."""
+        f = self.slow.compute_factor
+        if f > 1.0 and base_s > 0.0:
+            await asyncio.sleep(base_s * (f - 1.0))
+
+    def _deadline(self, phase: str, legacy: float) -> float:
+        """One deadline decision through the controller, traced when it
+        tightens the legacy constant (scrape-visible via the
+        biscotti_deadline_seconds gauge in _refresh_gauges)."""
+        decided = self.deadlines.deadline(phase, legacy)
+        if decided < legacy:
+            self._trace("deadline_adaptive", phase=phase,
+                        deadline_s=round(decided, 3), legacy_s=legacy)
+        return decided
+
+    async def _gather_quorum(self, phase: str, calls: Dict[int, object],
+                             need: int, legacy_s: float) -> int:
+        """Collection-point fan-out with partial-quorum graceful
+        degradation. `calls` maps peer id -> coroutine returning truthy
+        on success (its side effects carry the actual payload). Plane
+        DISARMED (cfg.adaptive_deadlines off): plain gather over the
+        same coroutines — the seed behavior, to the await. Armed: wait
+        for everyone until the phase's soft deadline (the controller's
+        estimate, clamped to `legacy_s`), then proceed the moment
+        `need` successes exist, CANCELLING the laggards — each counted
+        in biscotti_straggler_excluded_total{phase} and traced. A
+        cancelled _call records no breaker outcome (its BaseException
+        path hands back any probe slot), and nothing here touches
+        stake: an excluded honest straggler is an observability event,
+        never evidence. The waiting-on view tracks the pending set
+        either way; completed-phase durations feed the controller so a
+        later adaptive run warms up from history. Returns the success
+        count."""
+        if not calls:
+            return 0
+        tasks = {pid: asyncio.ensure_future(c) for pid, c in calls.items()}
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        armed = self.cfg.adaptive_deadlines
+        soft_s = self._deadline(phase, legacy_s) if armed else legacy_s
+
+        def successes() -> int:
+            return sum(1 for t in tasks.values()
+                       if t.done() and not t.cancelled()
+                       and t.exception() is None and t.result())
+
+        try:
+            while True:
+                pending = {pid: t for pid, t in tasks.items()
+                           if not t.done()}
+                self.straggler.waiting(phase, pending)
+                if not pending:
+                    # everyone answered: a full observation the
+                    # controller learns the phase's distribution from
+                    self.deadlines.observe(phase, loop.time() - t0)
+                    break
+                elapsed = loop.time() - t0
+                if armed and elapsed >= soft_s and successes() >= need:
+                    excluded = sorted(pending)
+                    for t in pending.values():
+                        t.cancel()
+                    await asyncio.gather(*pending.values(),
+                                         return_exceptions=True)
+                    self.straggler.exclude(phase, excluded)
+                    self._trace("straggler_excluded", phase=phase,
+                                peers=excluded,
+                                waited_s=round(elapsed, 3))
+                    break
+                timeout = (max(0.02, soft_s - elapsed)
+                           if (armed and elapsed < soft_s) else None)
+                await asyncio.wait(pending.values(), timeout=timeout,
+                                   return_when=(
+                                       asyncio.FIRST_COMPLETED
+                                       if elapsed >= soft_s and armed
+                                       else asyncio.ALL_COMPLETED))
+        finally:
+            self.straggler.clear(phase)
+            for t in tasks.values():
+                if not t.done():
+                    t.cancel()
+        return successes()
 
     # ---------------------------------------------------------- RPC surface
 
@@ -1986,6 +2120,7 @@ class PeerAgent:
                         ss.num_chunks(self.trainer.num_params, cfg.poly_size),
                         cfg.poly_size)
                 acc = st.vss_accum
+                t0_fold = time.monotonic()
                 with self.tele.span("intake_fold", it=st.iteration):
                     for sid, (comms, blinds) in pending.items():
                         booked = await asyncio.to_thread(
@@ -1997,6 +2132,7 @@ class PeerAgent:
                     for sid in await asyncio.to_thread(acc.fold):
                         self._vss_reject(st, sid,
                                          "share rows fail VSS verification")
+                await self._slow_pad(time.monotonic() - t0_fold)
                 for sid in pending:
                     st.miner_vss.pop(sid, None)
             if not finalize:
@@ -2008,8 +2144,10 @@ class PeerAgent:
             if xs is None:
                 st.vss_accum = None
                 return
+            t0_mv = time.monotonic()
             with self.tele.span("miner_verify", it=st.iteration):
                 ok = await asyncio.to_thread(acc.verify, xs)
+            await self._slow_pad(time.monotonic() - t0_mv)
             members = acc.members()
             self._trace("vss_batch_settled", n=len(members), ok=ok)
             if ok:
@@ -2054,9 +2192,11 @@ class PeerAgent:
             if not pending:
                 st.miner_vss.clear()
                 return
+            t0_mv = time.monotonic()
             with self.tele.span("miner_verify", it=st.iteration):
                 ok = await asyncio.to_thread(
                     cm.vss_verify_multi, list(pending.values()))
+            await self._slow_pad(time.monotonic() - t0_mv)
             self._trace("vss_batch_settled", n=len(pending), ok=ok)
             if ok:
                 # the whole batch is consistent AS A GROUP: remember who
@@ -2111,9 +2251,11 @@ class PeerAgent:
                 st.miner_vss_batch.pop(sid, None)
                 return False
             insts[sid] = (rec[0], xs, rows, rec[1])
+        t0_mv = time.monotonic()
         with self.tele.span("miner_verify", it=st.iteration):
             ok = await asyncio.to_thread(cm.vss_verify_multi,
                                          list(insts.values()))
+        await self._slow_pad(time.monotonic() - t0_mv)
         if ok:
             return True
         for sid, inst in insts.items():
@@ -2186,6 +2328,13 @@ class PeerAgent:
                         pool=len(st.verifier_pool),
                         thresh=self.cfg.krum_update_thresh)
             if len(st.verifier_pool) >= self.cfg.krum_update_thresh:
+                # threshold-triggered decision: its latency from round
+                # start is the krum timer's adaptive signal (timeout-
+                # path decisions are NOT observed — see _miner_flow)
+                if st.iteration == self.round.iteration:
+                    self.deadlines.observe(
+                        stragglers.KRUM,
+                        time.monotonic() - self._round_t0)
                 self._decide_round()
         accepted = await asyncio.wait_for(
             asyncio.shield(st.krum_decision), self.timeouts.krum_s * 2)
@@ -2654,6 +2803,7 @@ class PeerAgent:
         w = self.chain.latest_gradient()
         # heavy device call off the event loop: in-process clusters share one
         # loop, and a blocked loop starves every peer's timers
+        t0_sgd = time.monotonic()
         with self.tele.span("sgd", it=it):
             if spec is not None:
                 delta = spec["delta"]  # precomputed off this exact head
@@ -2662,6 +2812,16 @@ class PeerAgent:
             else:
                 delta = await asyncio.to_thread(self.trainer.private_fun,
                                                 w, it)
+        if spec is None:
+            # straggler plane (docs/STRAGGLERS.md): a slow peer's SGD step
+            # takes compute_factor x as long. Hive co-hosting memo-hits
+            # measure ~0 for their own await, so the pad bases on the
+            # shared batch's real cost there — TCP and loopback layouts
+            # slow identically.
+            base = time.monotonic() - t0_sgd
+            if self.stepper is not None:
+                base = max(base, getattr(self.stepper, "step_cost_s", 0.0))
+            await self._slow_pad(base)
         self.total_updates += 1
 
         noise = None
@@ -2679,34 +2839,65 @@ class PeerAgent:
         noised = delta
         if cfg.noising and not cfg.fedsys:
             draw = self._noiser_draw()
-            # privacy-attack accounting (ref: main.go:1026-1057, 1138-1144):
-            # colluders are the top `colluders%` of node ids (id ≥
-            # collusion_threshold); when a colluding verifier sees our
-            # noised delta AND every noiser we drew colludes, the colluders
-            # can cancel the noise and recover the raw update — count it
-            if cfg.colluders > 0:
-                verifiers_now, _, _, _ = self.role_map.committee()
-                thresh = cfg.collusion_threshold
-                if (any(v >= thresh for v in verifiers_now)
-                        and draw.noisers
-                        and all(n >= thresh for n in draw.noisers)):
-                    self._trace("unmasked_update")
             nmeta = {
                 "iteration": it, "source_id": self.id,
                 "noisers": list(draw.noisers),
                 "vrf_output": draw.output.hex(),
                 "vrf_proof": draw.proof.hex(),
             }
-            vectors = []
-            for nid in draw.noisers:
-                try:
-                    _, arrs = await self._call(nid, "RequestNoise", nmeta)
-                    vectors.append(np.asarray(arrs["noise"], np.float64))
-                except Exception:
-                    continue
+            got: Dict[int, np.ndarray] = {}
+            if cfg.adaptive_deadlines:
+                # partial-quorum noise collection (docs/STRAGGLERS.md):
+                # concurrent fan-out that proceeds with >= 1 vector once
+                # the phase's soft deadline passes — one straggling
+                # noiser no longer pins the worker for rpc_s x retries.
+                # Excluded noisers are counted, never breaker-fed (the
+                # cancelled _call records no outcome).
+                async def ask_noise(nid):
+                    try:
+                        _, arrs = await self._call(nid, "RequestNoise",
+                                                   nmeta)
+                        got[nid] = np.asarray(arrs["noise"], np.float64)
+                        return True
+                    except Exception:
+                        return False
+
+                await self._gather_quorum(
+                    stragglers.NOISE,
+                    {nid: ask_noise(nid) for nid in draw.noisers},
+                    need=1, legacy_s=self.timeouts.rpc_s)
+            else:
+                for nid in draw.noisers:
+                    try:
+                        _, arrs = await self._call(nid, "RequestNoise",
+                                                   nmeta)
+                        got[nid] = np.asarray(arrs["noise"], np.float64)
+                    except Exception:
+                        continue
+            # averaged in draw order (NOT completion order) so the armed
+            # fan-out's float reduction is deterministic in the
+            # collected set
+            used = [n for n in draw.noisers if n in got]
+            vectors = [got[n] for n in used]
             if vectors:
                 noise = np.mean(vectors, axis=0)
                 noised = delta + noise
+            # privacy-attack accounting (ref: main.go:1026-1057,1138-1144):
+            # colluders are the top `colluders%` of node ids (id ≥
+            # collusion_threshold); when a colluding verifier sees our
+            # noised delta AND every noiser whose vector actually masks
+            # it colludes, the colluders cancel the noise and recover the
+            # raw update — counted over the USED set, not the drawn one:
+            # a partial-quorum proceed (or a failed honest noiser on the
+            # seed path) that leaves only colluders' vectors in the mean
+            # is a real breach the drawn-set check would miss
+            if cfg.colluders > 0:
+                verifiers_now, _, _, _ = self.role_map.committee()
+                thresh = cfg.collusion_threshold
+                if (any(v >= thresh for v in verifiers_now)
+                        and used
+                        and all(n >= thresh for n in used)):
+                    self._trace("unmasked_update")
 
         q = self._quantize_np(delta)
         vss = None
@@ -2721,12 +2912,16 @@ class PeerAgent:
                 # serial one (same q, same context)
                 vss = spec["vss"]
             else:
+                t0_c = time.monotonic()
                 with self.tele.span("crypto_commit", it=it):
                     vss = await asyncio.to_thread(self._vss_build, q, it)
+                await self._slow_pad(time.monotonic() - t0_c)
             commitment = cm.vss_digest(vss[0])
         else:
+            t0_c = time.monotonic()
             with self.tele.span("crypto_commit", it=it):
                 commitment = await asyncio.to_thread(self._commit, q)
+            await self._slow_pad(time.monotonic() - t0_c)
         u = Update(source_id=self.id, iteration=it, delta=delta,
                    commitment=commitment, noise=noise, noised_delta=noised)
 
@@ -2755,12 +2950,23 @@ class PeerAgent:
                         else "VerifyUpdateRONI", meta, arrays,
                         timeout=self.timeouts.krum_s * 2 + self.timeouts.rpc_s)
                     sigs.append((v, bytes.fromhex(rmeta["signature"])))
+                    return True
                 except Exception as e:
                     self._trace("verify_call_failed", verifier=v,
                                 error=f"{type(e).__name__}: {e}")
+                    return False
 
+            # partial-quorum signature collection (docs/STRAGGLERS.md):
+            # disarmed this is a plain gather over the same coroutines
+            # (seed behavior); armed, the fan-out proceeds once the
+            # approval quorum is in hand after the phase's soft deadline
+            # instead of waiting out a straggling verifier's full
+            # krum_s*2+rpc_s budget
             with self.tele.span("verify_wait", it=it):
-                await asyncio.gather(*(ask(v) for v in verifiers))
+                await self._gather_quorum(
+                    stragglers.VERIFY, {v: ask(v) for v in verifiers},
+                    need=max(1, (len(verifiers) + 1) // 2),
+                    legacy_s=self.timeouts.krum_s * 2 + self.timeouts.rpc_s)
             # approved iff ≥ half the verifiers signed (ref: main.go:1686)
             approved = len(sigs) >= max(1, (len(verifiers) + 1) // 2)
             u.signers = [v for v, _ in sigs]
@@ -2785,11 +2991,13 @@ class PeerAgent:
         _, miners, _, _ = self.role_map.committee()
         if cfg.secure_agg and not cfg.fedsys:
             comms, blind_bytes, c_chunks = vss
+            t0_sh = time.monotonic()
             with self.tele.span("share_gen", it=it):
                 blind_rows = await asyncio.to_thread(
                     self._vss_blind_rows, blind_bytes, c_chunks)
                 shares = np.asarray(ss.make_shares(
                     np.asarray(q), cfg.poly_size, cfg.total_shares))
+            await self._slow_pad(time.monotonic() - t0_sh)
             for idx, m in enumerate(sorted(miners)):
                 sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
                 try:
@@ -2868,7 +3076,13 @@ class PeerAgent:
         st = self.round
         _, miners, _, _ = self.role_map.committee()
         sec = cfg.secure_agg and not cfg.fedsys
-        deadline = self.timeouts.share_s if sec else self.timeouts.update_s
+        phase = stragglers.SHARE if sec else stragglers.UPDATE
+        legacy = self.timeouts.share_s if sec else self.timeouts.update_s
+        # adaptive intake deadline (docs/STRAGGLERS.md): disarmed (or
+        # unwarmed) the controller answers `legacy` verbatim; armed, a
+        # fleet whose intakes historically complete in seconds stops
+        # riding the 90 s constant when a worker dies mid-round
+        deadline = self._deadline(phase, legacy)
         # both intake paths trigger at NUM_SAMPLES/2 — Krum approves about
         # half the pool (f=0.5·n), so a full-sample target would always ride
         # the deadline (ref: main.go:345-363 shares, main.go:1222-1230
@@ -2876,34 +3090,71 @@ class PeerAgent:
         # (ref: FedSys/main.go:530-558)
         target = (max(1, cfg.num_samples) if cfg.fedsys
                   else max(1, cfg.num_samples // 2))
+        expected = [n for n in self.peers
+                    if self.role_map.is_vanilla(n) or cfg.fedsys]
         t0 = time.monotonic()
         grace_until = None
-        while time.monotonic() - t0 < deadline:
-            have_map = st.miner_shares if sec else st.miner_updates
-            have = len(have_map)
-            # every expected contributor has responded — a submission, a
-            # provably bad one, or a signed decline (verifier-refused
-            # workers, RegisterDecline): mint at once. Union-counted so a
-            # Byzantine worker both declining and submitting is one peer.
-            accounted = len(have_map.keys() | st.miner_rejected.keys()
-                            | st.miner_declined)
-            if accounted >= cfg.num_samples:
-                break
-            if have >= target:
-                # quorum reached — hold a short straggler window so
-                # same-instant submissions (and their rejections) land in
-                # this block rather than silently missing the round
-                if grace_until is None:
-                    grace_until = time.monotonic() + min(1.0, deadline / 4)
-                elif time.monotonic() >= grace_until:
+        accounted_set: Set[int] = set()
+        try:
+            while time.monotonic() - t0 < deadline:
+                have_map = st.miner_shares if sec else st.miner_updates
+                have = len(have_map)
+                # every expected contributor has responded — a submission, a
+                # provably bad one, or a signed decline (verifier-refused
+                # workers, RegisterDecline): mint at once. Union-counted so a
+                # Byzantine worker both declining and submitting is one peer.
+                accounted_set = (have_map.keys() | st.miner_rejected.keys()
+                                 | st.miner_declined)
+                accounted = len(accounted_set)
+                # stall forensics: while blocked, publish exactly who this
+                # intake is waiting on (the obs `waiting-on` column)
+                self.straggler.waiting(
+                    phase, (n for n in expected if n not in accounted_set
+                            and n != self.id))
+                if accounted >= cfg.num_samples:
                     break
-            if st.block_done and st.block_done.is_set():
-                return  # someone else minted first
-            await asyncio.sleep(0.05)
+                if have >= target:
+                    # quorum reached — hold a short straggler window so
+                    # same-instant submissions (and their rejections) land in
+                    # this block rather than silently missing the round
+                    if grace_until is None:
+                        grace_until = time.monotonic() + min(1.0, deadline / 4)
+                    elif time.monotonic() >= grace_until:
+                        break
+                if st.block_done and st.block_done.is_set():
+                    return  # someone else minted first
+                await asyncio.sleep(0.05)
+        finally:
+            self.straggler.clear(phase)
+        # feed the controller BOTH outcomes: a satisfied intake records
+        # its real completion time, and an EXPIRED one records the full
+        # wait (== the deadline) — so a fleet that slowed past the
+        # adapted budget grows it back geometrically (×margin per
+        # expired round, ceiling = the legacy constant) instead of
+        # freezing a too-tight estimate forever and minting short with
+        # honest workers excluded every round; once intakes complete
+        # again, real observations pull the estimate back down
+        self.deadlines.observe(phase, time.monotonic() - t0)
         if self.id != self._miner_leader(miners):
             return  # non-leader miners rely on the block timer fallback
         if st.block_done and st.block_done.is_set():
             return
+        # straggler accounting at mint: the sampling design expects
+        # `num_samples` contributors — mints short of that proceeded
+        # without honest stragglers. Counted by the SHORTFALL (not every
+        # unaccounted worker: with sample_percent < 1 the design itself
+        # expects fewer responders than workers), traced with the
+        # candidate ids, NEVER debited (only provably-bad commitments
+        # are) and never breaker evidence — the ISSUE's
+        # honest-straggler-never-quarantined contract.
+        shortfall = cfg.num_samples - len(accounted_set)
+        if shortfall > 0 and (st.miner_shares if sec else st.miner_updates):
+            missing = sorted(n for n in expected
+                             if n not in accounted_set and n != self.id)
+            self.straggler.exclude(phase, missing[:shortfall])
+            self._trace("straggler_excluded", phase=phase,
+                        peers=missing, short=shortfall,
+                        waited_s=round(time.monotonic() - t0, 3))
         blk = await self._create_block()
         if blk is not None:
             self._accept_block(blk, gossip=True, minted=True)
@@ -3022,10 +3273,12 @@ class PeerAgent:
                     full = np.concatenate([slices[i]
                                            for i in range(len(miners))])
                     xs = self._xs_arr
+                    t0_rec = time.monotonic()
                     with self.tele.span("recovery", it=it):
                         agg = np.asarray(ss.recover_update(
                             full, xs, self.trainer.num_params,
                             cfg.poly_size, cfg.precision))
+                    await self._slow_pad(time.monotonic() - t0_rec)
             deltas = [Update(source_id=n, iteration=it,
                              delta=np.zeros(0, np.float64),
                              commitment=self.round.miner_commitments.get(n, b""),
@@ -3144,6 +3397,7 @@ class PeerAgent:
         st = self.round
         if self.role_map.is_miner(self.id) and self.cfg.secure_agg:
             st.my_xs = self._my_share_xs()
+        self._round_t0 = time.monotonic()
         self._trace("round_start",
                     verifier=self.role_map.is_verifier(self.id),
                     miner=self.role_map.is_miner(self.id))
@@ -3165,7 +3419,10 @@ class PeerAgent:
         work = []
         if self.role_map.is_verifier(self.id):
             async def krum_timer():
-                await asyncio.sleep(self.timeouts.krum_s)
+                # adaptive defense-decision timer (docs/STRAGGLERS.md):
+                # disarmed/unwarmed = the legacy krum_s fallback verbatim
+                await asyncio.sleep(self._deadline(stragglers.KRUM,
+                                                   self.timeouts.krum_s))
                 self._decide_round()  # timeout fallback (ref: krum.go:178-224)
             work.append(loop.create_task(krum_timer()))
         if self.role_map.is_miner(self.id):
@@ -3173,15 +3430,56 @@ class PeerAgent:
         if self.role_map.is_vanilla(self.id) or cfg.fedsys:
             if not (cfg.fedsys and self.id == 0):
                 work.append(loop.create_task(self._worker_flow()))
-        st.tasks.extend(work)
 
         # block deadline: every peer advances the round no matter what
-        # (ref: main.go:2326-2355 startBlockDeadlineTimer)
+        # (ref: main.go:2326-2355 startBlockDeadlineTimer). Armed, the
+        # controller shrinks this toward the fleet's observed round times
+        # (clamped to [floor, block_s]) — a dead miner costs the cluster
+        # roughly one typical round, not the full 300 s constant.
+        block_dl = self._deadline(stragglers.BLOCK, self.timeouts.block_s)
+        _, _miners_now, _, _ = self.role_map.committee()
+        leader = self._miner_leader(sorted(_miners_now)) \
+            if _miners_now else None
+
+        async def stall_watchdog():
+            # stall forensics (always-on, read-only): a round stuck past
+            # half its block deadline records WHICH phase it is blocked
+            # on and WHOM it awaits — biscotti_round_stalls_total{phase}
+            # plus a traced event carrying the peer ids, so a wedged
+            # production round is diagnosable from a scrape instead of a
+            # post-mortem log dig
+            await asyncio.sleep(max(0.05, block_dl / 2))
+            if st.block_done.is_set() or self.iteration != it:
+                return
+            waiting = {ph: ps for ph, ps in
+                       self.straggler.waiting_on.items() if ps}
+            if waiting:
+                ph, peers = next(iter(waiting.items()))
+            else:
+                ph, peers = stragglers.BLOCK, \
+                    ([leader] if leader is not None
+                     and leader != self.id else [])
+            self.straggler.stall(ph, peers, it)
+            self._trace("round_stall", phase=ph, peers=sorted(peers),
+                        after_s=round(block_dl / 2, 3))
+
+        work.append(loop.create_task(stall_watchdog()))
+        st.tasks.extend(work)
+
         try:
-            await asyncio.wait_for(st.block_done.wait(),
-                                   self.timeouts.block_s)
+            self.straggler.waiting(
+                stragglers.BLOCK,
+                [leader] if leader is not None and leader != self.id
+                else [])
+            await asyncio.wait_for(st.block_done.wait(), block_dl)
+            self.straggler.clear(stragglers.BLOCK)
+            # a block landed: the completed round duration is the
+            # controller's primary signal for next round's block budget
+            self.deadlines.observe(stragglers.BLOCK,
+                                   time.monotonic() - self._round_t0)
             self._empty_fallbacks = 0
         except asyncio.TimeoutError:
+            self.straggler.clear(stragglers.BLOCK)
             if self.iteration == it:
                 # before minting an empty block, try pulling the round's
                 # block from a few peers — if the network minted one and

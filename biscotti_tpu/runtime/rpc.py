@@ -380,6 +380,12 @@ class RPCServer:
         # FrameStream's mid-frame deadline on inbound connections
         self.admission = None
         self.read_deadline = 0.0
+        # straggler plane (runtime/stragglers.py, docs/STRAGGLERS.md):
+        # extra per-RPC service delay charged before every handler
+        # dispatch when this peer carries a slow speed profile. Owned by
+        # the TRANSPORT seam (here and mirrored by the hive loopback
+        # dispatch) so TCP and co-hosted layouts serve identically slow.
+        self.service_delay_s = 0.0
 
     async def start(self, bind_budget_s: float = 10.0) -> None:
         """Bind the listen socket, retrying transient EADDRINUSE.
@@ -544,6 +550,14 @@ class RPCServer:
     async def _dispatch(self, msg_type, meta, arrays, stream, write_lock):
         rid = meta.get("rid")
         try:
+            if self.service_delay_s > 0.0:
+                # slow-peer service emulation (docs/STRAGGLERS.md): a
+                # confidential-compute / overloaded host takes longer to
+                # SERVE each request — charged here, after admission
+                # (shedding stays cheap) and before the handler, so the
+                # caller's observed latency grows exactly like a genuinely
+                # slow service's would
+                await asyncio.sleep(self.service_delay_s)
             rmeta, rarrays = await self.handler(msg_type, meta, arrays)
         except StaleError as e:
             rmeta, rarrays = {"error": e.reason, "stale": True}, {}

@@ -17,6 +17,14 @@ peaks, so the ISSUE-5 acceptance run is replayable from the CLI:
     python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 3 \
         --flood 50 --flood-node 1 --admission 1
 
+Straggler scenario (docs/STRAGGLERS.md): a seeded fraction of the fleet
+runs heterogeneous speed profiles (compute pads + per-RPC service delay)
+while every peer's deadlines adapt; slow composes with flood and churn in
+one seeded replayable run:
+
+    python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 4 \
+        --fault-seed 1 --slow 0.25 --slow-preset tee --adaptive-deadlines 1
+
 Exit code 0 iff all peers finished with an equal settled chain prefix and
 at least one real (non-empty) block survived. The JSON report carries the
 per-peer fault tallies, retry/breaker counters, health snapshots, and
@@ -126,9 +134,37 @@ def main(argv=None) -> int:
                     help="1: churned/late peers catch up from a chain "
                          "snapshot (GetSnapshot) instead of replaying "
                          "genesis")
+    ap.add_argument("--slow", type=float, default=0.0,
+                    help="fraction of peers assigned a seeded slow speed "
+                         "profile (the straggler fault kind, "
+                         "docs/STRAGGLERS.md); composes with --flood and "
+                         "--churn in one replayable run")
+    ap.add_argument("--slow-node", type=int, default=-1,
+                    help="pin this node slow regardless of the fraction "
+                         "draw (-1: none)")
+    ap.add_argument("--slow-factor", type=float, default=4.0,
+                    help="compute-slowdown multiple for drawn slow peers "
+                         "(ignored when --slow-preset is set)")
+    ap.add_argument("--slow-service-s", type=float, default=0.0,
+                    help="extra per-RPC service delay for slow peers")
+    ap.add_argument("--slow-preset", default="",
+                    choices=["", "tee", "bimodal", "longtail"],
+                    help="named speed-profile preset: tee = the "
+                         "arXiv:2501.11771-calibrated confidential-"
+                         "compute overhead, bimodal = 2x/8x split, "
+                         "longtail = heavy-tail severities")
+    ap.add_argument("--adaptive-deadlines", type=int, default=0,
+                    help="1 arms the straggler-tolerance plane on every "
+                         "peer: adaptive per-phase round deadlines + "
+                         "partial-quorum graceful degradation")
     ns = ap.parse_args(argv)
     if ns.flood and not (0 <= ns.flood_node < ns.nodes):
         ap.error(f"--flood-node {ns.flood_node} outside 0..{ns.nodes - 1}")
+    if ns.slow_node >= ns.nodes:
+        # a typo'd id would silently run a homogeneous cluster labeled
+        # as a straggler scenario (slow_profile returns NO_SLOW outside
+        # the id space) — refuse loudly like --flood-node
+        ap.error(f"--slow-node {ns.slow_node} outside 0..{ns.nodes - 1}")
 
     import jax
 
@@ -140,25 +176,29 @@ def main(argv=None) -> int:
 
     churn_seed = ns.fault_seed if ns.churn_seed < 0 else ns.churn_seed
     # one plan: the frame-fault schedule keys off --fault-seed, the
-    # membership timeline off --churn-seed (FaultPlan.churn_seed) — so a
-    # churn ablation varying only --churn-seed replays the identical
-    # drop/delay/dup/reset schedule
+    # membership timeline off --churn-seed (FaultPlan.churn_seed), and
+    # the slow-profile table off --fault-seed too — so slow + flood +
+    # churn compose in ONE seeded replayable run
+    slow_kw = dict(slow=ns.slow, slow_factor=ns.slow_factor,
+                   slow_service_s=ns.slow_service_s,
+                   slow_preset=ns.slow_preset, slow_node=ns.slow_node)
     plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
                      delay=ns.fault_delay, delay_s=ns.fault_delay_s,
                      duplicate=ns.fault_dup, reset=ns.fault_reset,
                      churn=ns.churn, churn_period=ns.churn_period,
-                     churn_down=ns.churn_down, churn_seed=ns.churn_seed)
+                     churn_down=ns.churn_down, churn_seed=ns.churn_seed,
+                     **slow_kw)
     # the flooder rides the SAME seeded plan plus the replay factor, so
-    # a mixed run (drop + flood + churn) stays replayable from one seed —
-    # dropping the churn fields here would silently strip a flooding
-    # victim's self-kill schedule and change the membership timeline
+    # a mixed run (drop + flood + churn + slow) stays replayable from one
+    # seed — dropping the churn/slow fields here would silently strip a
+    # flooding victim's self-kill schedule or speed profile
     flood_plan = FaultPlan(seed=ns.fault_seed, drop=ns.fault_drop,
                            delay=ns.fault_delay, delay_s=ns.fault_delay_s,
                            duplicate=ns.fault_dup, reset=ns.fault_reset,
                            flood=ns.flood,
                            churn=ns.churn, churn_period=ns.churn_period,
                            churn_down=ns.churn_down,
-                           churn_seed=ns.churn_seed)
+                           churn_seed=ns.churn_seed, **slow_kw)
     admit = bool(ns.flood) if ns.admission < 0 else bool(ns.admission)
     # harness-scaled budgets: a 4-node fast-timeout loopback cluster's
     # honest rate is well under 1 frame/s/peer/class, so these rates are
@@ -186,6 +226,7 @@ def main(argv=None) -> int:
             fault_plan=flood_plan if flooding else plan,
             admission_plan=admission,
             snapshot_bootstrap=bool(ns.snapshot_bootstrap),
+            adaptive_deadlines=bool(ns.adaptive_deadlines),
             wire_codec=ns.codec)
 
     if ns.churn > 0:
@@ -227,7 +268,30 @@ def main(argv=None) -> int:
                   "period": ns.churn_period, "down": ns.churn_down,
                   "events_applied": applied}
                  if ns.churn else None,
+        "slow": {"fraction": ns.slow, "node": ns.slow_node,
+                 "factor": ns.slow_factor, "preset": ns.slow_preset,
+                 "profiles": {
+                     str(n): {"compute_factor": p.compute_factor,
+                              "service_s": p.service_s}
+                     for n, p in plan.slow_table(ns.nodes).items()}}
+                if (ns.slow > 0 or ns.slow_node >= 0) else None,
+        "adaptive_deadlines": bool(ns.adaptive_deadlines),
         "admission_enabled": admit,
+        # straggler readout (docs/STRAGGLERS.md): cluster excluded/stall
+        # tallies + slowest-peer table (obs.merge_stragglers — one
+        # definition with a live scrape) and each peer's bounded
+        # deadline-decision history, so a straggler run's adaptive
+        # behavior is auditable from the report alone
+        "stragglers": {
+            **cluster["stragglers"],
+            "deadline_history": {
+                str(s["node"]): (s.get("stragglers", {})
+                                 .get("deadlines", {}).get("history", []))
+                for s in (r["telemetry"] for r in results
+                          if "telemetry" in r)
+                if s.get("stragglers", {}).get("deadlines", {})
+                .get("history")},
+        },
         "settled_prefix_equal": prefix_equal,
         "settled_height": common,
         "real_blocks": real_blocks,

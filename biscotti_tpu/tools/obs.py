@@ -138,6 +138,54 @@ def merge_admission(snaps: List[Dict]) -> Dict:
     return out
 
 
+def merge_stragglers(snaps: List[Dict]) -> Dict:
+    """Merge every peer's straggler readout (straggler-tolerance plane,
+    docs/STRAGGLERS.md) into one cluster view: excluded-straggler and
+    round-stall totals by phase, the live `waiting-on` map (which peer
+    is blocked on whom — the stuck-round forensics column), and the
+    slow-fleet table (every peer reporting an emulated slowdown,
+    slowest first)."""
+    out: Dict = {"excluded_total": 0, "excluded_by_phase": {},
+                 "stalls_total": 0, "stalls_by_phase": {},
+                 "waiting_on": {}, "slow_peers": [],
+                 "adaptive_peers": 0, "deadlines": {}}
+    for snap in snaps:
+        s = snap.get("stragglers") or {}
+        for ph, v in (s.get("excluded") or {}).items():
+            out["excluded_total"] += int(v)
+            out["excluded_by_phase"][ph] = \
+                out["excluded_by_phase"].get(ph, 0) + int(v)
+        for ph, v in (s.get("stalls") or {}).items():
+            out["stalls_total"] += int(v)
+            out["stalls_by_phase"][ph] = \
+                out["stalls_by_phase"].get(ph, 0) + int(v)
+        waiting = {ph: ps for ph, ps in (s.get("waiting_on") or {}).items()
+                   if ps}
+        if waiting:
+            out["waiting_on"][str(snap.get("node"))] = waiting
+        prof = s.get("profile") or {}
+        if prof.get("slowed"):
+            out["slow_peers"].append({
+                "node": snap.get("node"),
+                "compute_factor": prof.get("compute_factor", 1.0),
+                "service_s": prof.get("service_s", 0.0),
+                "preset": prof.get("preset", "")})
+        dl = s.get("deadlines") or {}
+        if dl.get("enabled"):
+            out["adaptive_peers"] += 1
+        for ph, row in (dl.get("phases") or {}).items():
+            if not row.get("adaptive"):
+                continue
+            cur = out["deadlines"].setdefault(
+                ph, {"min_s": row["deadline_s"], "max_s": row["deadline_s"],
+                     "peers": 0})
+            cur["min_s"] = min(cur["min_s"], row["deadline_s"])
+            cur["max_s"] = max(cur["max_s"], row["deadline_s"])
+            cur["peers"] += 1
+    out["slow_peers"].sort(key=lambda r: -r["compute_factor"])
+    return out
+
+
 def merge_hives(snaps: List[Dict]) -> Dict[str, Dict]:
     """Per-host hive table (runtime/hive.py, docs/HIVE.md): every
     co-hosted peer's snapshot carries its hive's shared readout under
@@ -198,6 +246,10 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
                              if h.get("state") in OPEN_STATES)
         breakers_open += len(quarantined)
         member = s.get("membership") or {}
+        strag = s.get("stragglers") or {}
+        waiting = {ph: ps for ph, ps in
+                   (strag.get("waiting_on") or {}).items() if ps}
+        prof = strag.get("profile") or {}
         per_node.append({
             "node": s.get("node"),
             "iter": s.get("iter", 0),
@@ -212,6 +264,13 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
             "epoch": int(member.get("epoch", 0)),
             "alive": int(member.get("alive", 0)),
             "pruned_before": int(member.get("pruned_before", 0)),
+            # straggler plane (docs/STRAGGLERS.md): what this peer is
+            # blocked on RIGHT NOW ("phase:peers" forensics) and its
+            # emulated slowdown, the obs table's waiting-on column
+            "waiting_on": waiting,
+            "slow_factor": float(prof.get("compute_factor", 1.0)),
+            "straggler_excluded": sum(
+                (strag.get("excluded") or {}).values()),
         })
     hs = list(heights.values()) or [0]
     wire = merge_wire(snaps)
@@ -233,6 +292,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "counters": counters,
         "wire": wire,
         "admission": merge_admission(snaps),
+        "stragglers": merge_stragglers(snaps),
         "hives": merge_hives(snaps),
         "phases": merge_phase_histograms(snaps),
         "per_node": per_node,
@@ -248,7 +308,8 @@ def format_table(merged: Dict) -> str:
         f"breakers open: {merged['breakers_open']}",
         "",
         f"{'node':>5} {'iter':>5} {'conv':>5} {'epoch':>6} {'alive':>6} "
-        f"{'opens':>6} {'fastfail':>8}  quarantined / faults",
+        f"{'opens':>6} {'fastfail':>8} {'waiting-on':>12}  "
+        "quarantined / faults",
     ]
     for n in merged["per_node"]:
         extra = []
@@ -259,10 +320,21 @@ def format_table(merged: Dict) -> str:
                 f"{k}:{v}" for k, v in sorted(n["faults"].items())))
         if n.get("pruned_before"):
             extra.append(f"pruned<{n['pruned_before']}")
+        if n.get("slow_factor", 1.0) > 1.0:
+            extra.append(f"slow={n['slow_factor']:g}x")
+        if n.get("straggler_excluded"):
+            extra.append(f"excluded={n['straggler_excluded']}")
+        # stuck-round forensics (docs/STRAGGLERS.md): "phase:ids" of
+        # whatever collection point this peer is blocked on right now
+        waiting = n.get("waiting_on") or {}
+        wcol = ";".join(
+            f"{ph}:{','.join(map(str, ps[:4]))}"
+            + ("+" if len(ps) > 4 else "")
+            for ph, ps in sorted(waiting.items())) or "-"
         lines.append(f"{n['node']!s:>5} {n['iter']:>5} "
                      f"{str(n['converged'])[:1]:>5} {n.get('epoch', 0):>6} "
                      f"{n.get('alive', 0):>6} {n['breaker_opens']:>6} "
-                     f"{n['fast_fails']:>8}  {' '.join(extra)}")
+                     f"{n['fast_fails']:>8} {wcol:>12}  {' '.join(extra)}")
     wire = merged.get("wire") or {}
     if (wire.get("out_bytes") or wire.get("in_bytes")
             or wire.get("loopback_bytes")):
@@ -286,6 +358,23 @@ def format_table(merged: Dict) -> str:
                       + f"   inflight peak {adm['inflight_peak']}"
                       f"   parked peak {adm['parked_peak']}"
                       f"   [{adm['enabled_peers']} peers enforcing]"]
+    strag = merged.get("stragglers") or {}
+    if (strag.get("excluded_total") or strag.get("stalls_total")
+            or strag.get("slow_peers") or strag.get("adaptive_peers")):
+        by_phase = ", ".join(f"{k}:{v}" for k, v in
+                             sorted(strag["excluded_by_phase"].items()))
+        slow = ", ".join(
+            f"{r['node']}@{r['compute_factor']:g}x"
+            + (f"+{r['service_s'] * 1e3:.0f}ms" if r["service_s"] else "")
+            for r in strag["slow_peers"][:6])
+        dl = ", ".join(f"{ph}:{row['min_s']:g}-{row['max_s']:g}s"
+                       for ph, row in sorted(strag["deadlines"].items()))
+        lines += ["", f"stragglers: excluded {strag['excluded_total']}"
+                      + (f" ({by_phase})" if by_phase else "")
+                      + f"   stalls {strag['stalls_total']}"
+                      + (f"   slow [{slow}]" if slow else "")
+                      + (f"   deadlines [{dl}]" if dl else "")
+                      + f"   [{strag['adaptive_peers']} peers adaptive]"]
     hives = merged.get("hives") or {}
     if hives:
         lines += ["", f"{'hive':<16} {'peers':>6} {'scraped':>8} "

@@ -43,7 +43,8 @@ class _FakeAgent:
     def __init__(self, pid, port, metrics=None, plan=None, handler=None):
         self.id = pid
         self.peers = {pid: ("127.0.0.1", port)}
-        self.server = SimpleNamespace(serving=True, metrics=metrics)
+        self.server = SimpleNamespace(serving=True, metrics=metrics,
+                                      service_delay_s=0.0)
         self.admission = AdmissionController(plan or AdmissionPlan())
         self._handler = handler
         self.handled = []

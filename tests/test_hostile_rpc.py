@@ -46,9 +46,13 @@ HOSTILE_ARRAYS = [
 ]
 
 
+PORT = 15600  # below the box's ephemeral range (16000+): an outbound
+# socket of a concurrent test cannot be dealt this listen port
+
+
 def test_agent_survives_hostile_rpcs_and_still_serves():
     cfg = BiscottiConfig(
-        node_id=0, num_nodes=3, dataset="creditcard", base_port=25600,
+        node_id=0, num_nodes=3, dataset="creditcard", base_port=PORT,
         num_verifiers=1, num_miners=1, num_noisers=1,
         secure_agg=True, noising=True, verification=True,
         defense=Defense.KRUM, max_iterations=1, convergence_error=0.0,
@@ -58,25 +62,42 @@ def test_agent_survives_hostile_rpcs_and_still_serves():
     async def go():
         agent = PeerAgent(cfg)
         await agent.server.start()
+        loop = asyncio.get_event_loop()
         try:
             async def one(method, meta, arrays):
-                try:
-                    await rpc.call("127.0.0.1", 25600, method,
-                                   dict(meta), dict(arrays), timeout=1.5)
-                    return "accepted"
-                except rpc.RPCError:
-                    return "refused"  # polite refusal — the point
-                except asyncio.TimeoutError:
-                    # in-horizon iterations may PARK (the protocol's
-                    # catch-up semantics); liveness is asserted below.
-                    # Past-the-run iterations must NOT park:
-                    it = meta.get("iteration")
-                    assert not (isinstance(it, int)
-                                and it > cfg.max_iterations), \
-                        f"far-future {method} parked instead of refused"
-                    return "parked"
-                except ConnectionError:
-                    pytest.fail(f"agent died on {method} {meta}")
+                # Condition-driven outcome classification (the
+                # conftest.wait_until pattern that de-flaked the
+                # kill/rejoin and geo-latency races): the OBSERVABLE
+                # state a hostile call must reach is a definitive reply
+                # — a polite refusal or an acceptance. The old fixed
+                # 1.5 s client budget raced the box's load: a slow-but-
+                # coming refusal was misclassified as "parked" and the
+                # far-future assert failed spuriously. Only calls whose
+                # iteration may legitimately PARK (in-horizon catch-up
+                # semantics) keep a short abandon budget — for them a
+                # timeout asserts nothing; liveness is proven below.
+                it = meta.get("iteration")
+                parkable = (isinstance(it, int)
+                            and 0 <= it <= cfg.max_iterations)
+                deadline = loop.time() + 120.0
+                while True:
+                    try:
+                        await rpc.call("127.0.0.1", PORT, method,
+                                       dict(meta), dict(arrays),
+                                       timeout=2.0 if parkable else 20.0)
+                        return "accepted"
+                    except rpc.RPCError:
+                        return "refused"  # polite refusal — the point
+                    except asyncio.TimeoutError:
+                        if parkable:
+                            return "parked"
+                        # far-future/malformed: the refusal is coming —
+                        # retry until it arrives, the budget is only a
+                        # generous backstop a loaded box stretches into
+                        assert loop.time() < deadline, \
+                            f"{method} {meta} never resolved to a reply"
+                    except ConnectionError:
+                        pytest.fail(f"agent died on {method} {meta}")
 
             outcomes = await asyncio.gather(*(
                 one(m, meta, arrays)
@@ -85,12 +106,27 @@ def test_agent_survives_hostile_rpcs_and_still_serves():
                 for arrays in HOSTILE_ARRAYS
             ))
             errors = outcomes.count("refused")
-            # the agent is still alive and serves an honest request
-            cmeta, carrays = await rpc.call(
-                "127.0.0.1", 25600, "RegisterPeer",
-                {"source_id": 1, "host": "127.0.0.1", "port": 25601},
-                timeout=5.0)
-            assert "blocks" in cmeta
+            # the agent is still alive and serves an honest request —
+            # condition-driven too: retry transient timeouts until the
+            # reply lands (the budget is the backstop, not the race)
+            reply = {}
+
+            async def honest_served():
+                try:
+                    cmeta, _ = await rpc.call(
+                        "127.0.0.1", PORT, "RegisterPeer",
+                        {"source_id": 1, "host": "127.0.0.1",
+                         "port": PORT + 1}, timeout=10.0)
+                    reply.update(cmeta)
+                    return True
+                except (asyncio.TimeoutError, ConnectionError):
+                    return False
+
+            deadline = loop.time() + 120.0
+            while not await honest_served():
+                assert loop.time() < deadline, \
+                    "agent no longer serves honest traffic"
+            assert "blocks" in reply
             return errors
         finally:
             await agent.server.stop()

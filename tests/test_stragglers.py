@@ -1,0 +1,573 @@
+"""Straggler-tolerance plane tests (ISSUE 10; docs/STRAGGLERS.md).
+
+Unit level: seeded slow-profile determinism + preset shapes, the
+DeadlineController's warm-up/clamp/quantile math, the reference's
+Timeouts.scaled startup scaling rule for rule (the constants the adaptive
+controller clamps against — previously untested), and partial-quorum
+semantics of PeerAgent._gather_quorum.
+
+Integration level (tier-1, small-N live TCP): the per-RPC service delay
+charged identically by the TCP server and the hive loopback dispatch
+(layout invariance), a defaults-off cluster with ZERO straggler-plane
+activity (the bit-identity guard, like test_pipeline's), a slow-peer
+cluster where honest stragglers are excluded but never breaker-quarantined
+or stake-debited, and the headline scenario: an adaptive 4-node cluster
+whose round advances in a small multiple of the typical round time after
+its leader miner is hard-killed — instead of riding the fixed block_s.
+
+The heavier 20%-tee mnist acceptance run is `slow`+`straggler`
+(`pytest -m straggler` includes it; tier-1 runs only the fast subset).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from conftest import wait_until
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.runtime import stragglers
+from biscotti_tpu.runtime.faults import NO_SLOW, FaultPlan, SlowProfile
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.tools import chaos, obs
+
+FAST = Timeouts(update_s=4.0, block_s=12.0, krum_s=3.0, share_s=4.0,
+                rpc_s=4.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=3, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+# ---------------------------------------------- Timeouts.scaled (satellite)
+
+
+def test_scaled_is_identity_below_the_thresholds():
+    """Base constants survive scaling untouched for a small plain
+    cluster: N<200 gives multiplier 1 (integer division), committees
+    <=10 trigger nothing, no random sampling."""
+    t = Timeouts()
+    s = t.scaled(num_nodes=100, num_verifiers=3, num_miners=3)
+    assert (s.update_s, s.block_s, s.krum_s, s.share_s, s.rpc_s) == \
+        (t.update_s, t.block_s, t.krum_s, t.share_s, t.rpc_s)
+    # 199 nodes: 199//100 == 1, still identity (ref main.go:810-825)
+    s = t.scaled(num_nodes=199, num_verifiers=3, num_miners=3)
+    assert s == t.scaled(100, 3, 3)
+
+
+def test_scaled_random_sampling_doubles_rpc_and_update_iff_krum():
+    t = Timeouts()
+    s = t.scaled(100, 3, 3, random_sampling=True, defense_is_krum=True)
+    assert s.rpc_s == t.rpc_s * 2 and s.update_s == t.update_s * 2
+    assert s.krum_s == t.krum_s and s.block_s == t.block_s
+    # the doubling is gated on the Krum defense (ref main.go:788-791)
+    s = t.scaled(100, 3, 3, random_sampling=True, defense_is_krum=False)
+    assert s.rpc_s == t.rpc_s and s.update_s == t.update_s
+
+
+def test_scaled_committee_doublings_fire_only_at_n100():
+    t = Timeouts()
+    # >10 miners at N=100: update doubles (ref main.go:796-800)
+    s = t.scaled(100, 3, 11)
+    assert s.update_s == t.update_s * 2 and s.krum_s == t.krum_s
+    # >10 verifiers at N=100: krum AND update double (ref main.go:802-807)
+    s = t.scaled(100, 11, 3)
+    assert s.krum_s == t.krum_s * 2 and s.update_s == t.update_s * 2
+    # the same committees at N=50 trigger NEITHER (the ==100 gate)
+    s = t.scaled(50, 11, 11)
+    assert s == t.scaled(50, 3, 3)
+
+
+def test_scaled_node_count_multiplier_is_integer_division():
+    t = Timeouts()
+    s = t.scaled(250, 3, 3)  # 250//100 == 2
+    assert (s.update_s, s.krum_s, s.block_s, s.rpc_s, s.share_s) == \
+        (t.update_s * 2, t.krum_s * 2, t.block_s * 2, t.rpc_s * 2,
+         t.share_s * 2)
+    s3 = t.scaled(399, 3, 3)  # 399//100 == 3
+    assert s3.block_s == t.block_s * 3
+
+
+def test_scaled_rules_compose_multiplicatively():
+    """All three rules together, in the reference's application order:
+    random-sampling doubling, then committee doublings (N==100 only),
+    then the N//100 multiplier over everything."""
+    t = Timeouts()
+    s = t.scaled(100, 11, 11, random_sampling=True, defense_is_krum=True)
+    # update: x2 (rs) x2 (miners>10) x2 (verifiers>10) = x8
+    assert s.update_s == t.update_s * 8
+    assert s.krum_s == t.krum_s * 2
+    assert s.rpc_s == t.rpc_s * 2
+    # at N=300 the committee doublings do NOT fire (==100 gate) but the
+    # multiplier does: update x2 (rs) x3
+    s = t.scaled(300, 11, 11, random_sampling=True, defense_is_krum=True)
+    assert s.update_s == t.update_s * 2 * 3
+    assert s.krum_s == t.krum_s * 3
+
+
+# ------------------------------------------------------- slow profiles
+
+
+@pytest.mark.straggler
+def test_slow_profile_deterministic_pure_and_gated():
+    plan = FaultPlan(seed=11, slow=0.3, slow_factor=5.0,
+                     slow_service_s=0.02)
+    again = FaultPlan(seed=11, slow=0.3, slow_factor=5.0,
+                      slow_service_s=0.02)
+    other = FaultPlan(seed=12, slow=0.3, slow_factor=5.0)
+    n = 40
+    table = plan.slow_table(n)
+    assert table and table == again.slow_table(n), \
+        "same seed must give the identical fleet table"
+    assert set(table) != set(other.slow_table(n)), \
+        "a different seed must draw a different slow set"
+    for prof in table.values():
+        assert prof == SlowProfile(compute_factor=5.0, service_s=0.02)
+    # roughly the configured fraction is drawn (independent per-node draws)
+    assert 0.1 * n < len(table) < 0.55 * n
+    # disabled plan: nobody is slow, not even with a factor configured
+    off = FaultPlan(slow_factor=9.0)
+    assert not off.slow_enabled
+    assert off.slow_profile(3, n) is NO_SLOW
+    # slow_node pins its node regardless of the draw, fraction 0
+    pin = FaultPlan(seed=11, slow_node=7, slow_factor=3.0)
+    assert pin.slow_profile(7, n).compute_factor == 3.0
+    assert not pin.slow_profile(8, n).slowed
+
+
+@pytest.mark.straggler
+def test_slow_presets_shapes():
+    n = 64
+    tee = FaultPlan(seed=4, slow=0.25, slow_preset="tee").slow_table(n)
+    assert tee
+    for p in tee.values():  # the arXiv:2501.11771-calibrated profile
+        assert p.compute_factor == 4.0 and p.service_s == 0.02
+    bim = FaultPlan(seed=4, slow=0.25, slow_preset="bimodal").slow_table(n)
+    assert set(p.compute_factor for p in bim.values()) == {2.0, 8.0}
+    lt = FaultPlan(seed=4, slow=0.5, slow_preset="longtail").slow_table(n)
+    factors = [p.compute_factor for p in lt.values()]
+    assert all(1.0 <= f <= 16.0 for f in factors)
+    assert len(set(factors)) > 3, "longtail severities must spread"
+    # an unknown preset fails at config construction, not mid-round
+    with pytest.raises(ValueError):
+        BiscottiConfig(fault_plan=FaultPlan(slow=0.1, slow_preset="warp"))
+
+
+@pytest.mark.straggler
+def test_slow_profile_layout_invariance_tcp_vs_loopback():
+    """The SAME seeded plan gives a TCP-standalone agent and a
+    hive-co-hosted agent identical profiles and service-delay settings:
+    the profile is pure in (seed, node) and the delay lives on the
+    transport seam both dispatch paths read."""
+    from biscotti_tpu.runtime.hive import LoopbackHub
+
+    plan = FaultPlan(seed=9, slow=0.5, slow_factor=3.0,
+                     slow_service_s=0.04)
+    hub = LoopbackHub()
+    n = 4
+    standalone = [PeerAgent(_cfg(i, n, 15310, fault_plan=plan))
+                  for i in range(n)]
+    cohosted = [PeerAgent(_cfg(i, n, 15320, fault_plan=plan), hive=hub)
+                for i in range(n)]
+    for a, b in zip(standalone, cohosted):
+        assert a.slow == b.slow == plan.slow_profile(a.id, n)
+        assert a.server.service_delay_s == b.server.service_delay_s \
+            == a.slow.service_s
+
+
+@pytest.mark.straggler
+def test_service_delay_charged_on_both_transports():
+    """A slow peer's per-RPC service delay is observable from BOTH
+    transports: a TCP call and a loopback call each take at least the
+    configured delay (lower-bound asserts only — sleeps guarantee a
+    minimum, so box load cannot flake this)."""
+    from biscotti_tpu.runtime import rpc
+    from biscotti_tpu.runtime.hive import LoopbackHub
+
+    delay = 0.15
+    plan = FaultPlan(slow_node=0, slow_service_s=delay, slow_factor=1.0)
+    hub = LoopbackHub()
+
+    async def go():
+        agent = PeerAgent(_cfg(0, 2, 15340, fault_plan=plan), hive=hub)
+        assert agent.server.service_delay_s == delay
+        await agent.server.start()
+        try:
+            t0 = time.monotonic()
+            rmeta, _ = await rpc.call("127.0.0.1", 15340, "Metrics", {},
+                                      timeout=20.0)
+            tcp_elapsed = time.monotonic() - t0
+            assert "snapshot" in rmeta
+            ep = hub.lookup("127.0.0.1", 15340)
+            assert ep is not None
+            t0 = time.monotonic()
+            rmeta2, _ = await ep.call("Metrics", {}, {}, 20.0, src=1)
+            loop_elapsed = time.monotonic() - t0
+            assert "snapshot" in rmeta2
+            return tcp_elapsed, loop_elapsed
+        finally:
+            await agent.server.stop()
+
+    tcp_elapsed, loop_elapsed = asyncio.run(go())
+    assert tcp_elapsed >= delay * 0.9, \
+        f"TCP dispatch skipped the service delay ({tcp_elapsed:.3f}s)"
+    assert loop_elapsed >= delay * 0.9, \
+        f"loopback dispatch skipped the service delay ({loop_elapsed:.3f}s)"
+
+
+# -------------------------------------------------- DeadlineController
+
+
+@pytest.mark.straggler
+def test_controller_disabled_and_warmup_answer_legacy():
+    dc = stragglers.DeadlineController(enabled=False)
+    for _ in range(10):
+        dc.observe("block", 0.5)
+    assert dc.deadline("block", 300.0) == 300.0, \
+        "disabled controller must answer the legacy constant verbatim"
+    dc = stragglers.DeadlineController(enabled=True, min_samples=3)
+    dc.observe("block", 0.5)
+    dc.observe("block", 0.5)
+    assert dc.deadline("block", 300.0) == 300.0, \
+        "short of min_samples the legacy constant stands (warm-up = " \
+        "seed behavior)"
+    dc.observe("block", 0.5)
+    assert dc.deadline("block", 300.0) < 300.0
+
+
+@pytest.mark.straggler
+def test_controller_clamps_floor_legacy_and_margin_math():
+    dc = stragglers.DeadlineController(enabled=True, margin=2.0,
+                                       floor_s=1.0, min_samples=3)
+    # uniform 2 s rounds: estimate == 2.0, deadline = 2.0 * 2.0 = 4.0
+    for _ in range(8):
+        dc.observe("block", 2.0)
+    assert dc.deadline("block", 300.0) == pytest.approx(4.0)
+    # the legacy constant is a hard ceiling
+    assert dc.deadline("block", 3.0) == pytest.approx(3.0)
+    # a burst of sub-floor rounds clamps UP to the floor
+    for _ in range(64):
+        dc.observe("krum", 0.01)
+    assert dc.deadline("krum", 60.0) == pytest.approx(1.0)
+    # a slow-but-honest fleet EARNS a longer budget (larger estimate),
+    # still under its ceiling
+    for _ in range(8):
+        dc.observe("share", 20.0)
+    assert dc.deadline("share", 90.0) == pytest.approx(40.0)
+
+
+@pytest.mark.straggler
+def test_controller_p95_keeps_the_tail_and_history_records():
+    dc = stragglers.DeadlineController(enabled=True, margin=1.0,
+                                       floor_s=0.1, min_samples=3,
+                                       window=64, alpha=0.2)
+    # 60 fast rounds then 4 slow ones: the EWMA alone would forget the
+    # tail; the windowed p95 must keep the deadline above the slow mode
+    for _ in range(60):
+        dc.observe("block", 0.2)
+    for _ in range(4):
+        dc.observe("block", 5.0)
+    assert dc.p95("block") == pytest.approx(5.0)
+    assert dc.deadline("block", 300.0) >= 5.0
+    assert dc.history, "decisions must be recorded"
+    last = dc.history[-1]
+    assert last["phase"] == "block" and last["adaptive"]
+
+
+# -------------------------------------------------- partial quorum units
+
+
+@pytest.mark.straggler
+def test_gather_quorum_disarmed_waits_all_armed_proceeds_and_counts():
+    async def go():
+        # disarmed agent: the fan-out waits for EVERY coroutine (seed
+        # behavior) — the slow one completes, nothing is excluded
+        agent = PeerAgent(_cfg(0, 3, 15360))
+        order = []
+
+        def mk(tag, dt, ok=True):
+            async def c():
+                await asyncio.sleep(dt)
+                order.append(tag)
+                return ok
+            return c()
+
+        n_ok = await agent._gather_quorum(
+            "verify", {1: mk("fast", 0.0), 2: mk("slow", 0.3)},
+            need=1, legacy_s=5.0)
+        assert n_ok == 2 and "slow" in order
+        assert agent.straggler.excluded == {}
+
+        # armed agent with a warmed controller: once the soft deadline
+        # passes and the quorum is met, the laggard is CANCELLED and
+        # counted — and the breaker never heard about it
+        agent2 = PeerAgent(_cfg(0, 3, 15362, adaptive_deadlines=True,
+                                deadline_floor_s=0.1))
+        for _ in range(5):
+            agent2.deadlines.observe("verify", 0.05)
+        ran = []
+
+        async def never():
+            try:
+                await asyncio.sleep(60.0)
+                ran.append("never")
+                return True
+            except asyncio.CancelledError:
+                raise
+
+        t0 = time.monotonic()
+        n_ok = await agent2._gather_quorum(
+            "verify", {1: mk("fast2", 0.0), 2: never()},
+            need=1, legacy_s=60.0)
+        elapsed = time.monotonic() - t0
+        assert n_ok == 1 and not ran
+        assert elapsed < 5.0, f"quorum proceed took {elapsed:.1f}s"
+        assert agent2.straggler.excluded.get("verify") == 1
+        assert agent2.counters.get("straggler_excluded") == 1
+        # the excluded peer was never breaker evidence
+        health = agent2.health.snapshot()
+        assert all(h["state"] == "closed" and h["total_failures"] == 0
+                   for h in health.values())
+        # the waiting-on entry is cleared once the phase resolves
+        assert "verify" not in agent2.straggler.waiting_on
+        return True
+
+    assert asyncio.run(go())
+
+
+@pytest.mark.straggler
+def test_straggler_ledger_counts_and_metrics():
+    from biscotti_tpu.telemetry import MetricsRegistry
+
+    led = stragglers.StragglerLedger()
+    led.metrics = reg = MetricsRegistry()
+    led.waiting("share", [3, 1])
+    assert led.waiting_on == {"share": [1, 3]}
+    led.exclude("share", [1])
+    led.stall("share", [3], height=7)
+    led.waiting("share", [])
+    snap = led.snapshot()
+    assert snap["excluded"] == {"share": 1}
+    assert snap["stalls"] == {"share": 1}
+    assert snap["waiting_on"] == {}
+    assert snap["last_stall"]["peers"] == [3]
+    assert reg.counter(stragglers.EXCLUDED_METRIC).value(phase="share") == 1
+    assert reg.counter(stragglers.STALLS_METRIC).value(phase="share") == 1
+
+
+# ------------------------------------------------ live clusters (tier-1)
+
+
+def _settled_prefix_equal(results, min_common=1):
+    eq, common, real = chaos.chain_oracle(results)
+    assert eq, "settled chain prefixes diverged"
+    assert common >= min_common
+    return real
+
+
+@pytest.mark.straggler
+def test_defaults_off_cluster_has_zero_straggler_activity():
+    """The bit-identity guard (like test_pipeline's defaults-off knob
+    guard): with the plane off — no slow plan, no adaptive deadlines —
+    a seeded cluster finishes with chains equal, ZERO straggler
+    counters, every deadline decision the legacy constant, and no pads
+    (the slow gauge reads 1.0 everywhere)."""
+    n, port = 4, 15380
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port)) for i in range(n)]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    agents, results = asyncio.run(go())
+    _settled_prefix_equal(results)
+    for r in results:
+        s = r["telemetry"]["stragglers"]
+        assert not s["profile"]["slowed"]
+        assert s["excluded"] == {} and s["stalls"] == {}
+        assert not s["deadlines"]["enabled"]
+        for row in s["deadlines"]["phases"].values():
+            assert not row.get("adaptive", False)
+        assert r["counters"].get("straggler_excluded", 0) == 0
+        assert r["counters"].get("deadline_adaptive", 0) == 0
+        mets = r["telemetry"]["metrics"]
+        assert stragglers.EXCLUDED_METRIC not in mets
+        fam = mets.get("biscotti_slow_compute_factor", {})
+        for row in fam.get("series", []):
+            assert row["value"] == 1.0
+
+
+@pytest.mark.straggler
+def test_slow_cluster_honest_straggler_never_quarantined():
+    """A 4-node cluster with one 4x+service-delayed peer under adaptive
+    deadlines: chains settle equal, the slow peer is visible in every
+    snapshot, and — the plane's core contract — it is NEVER breaker-
+    quarantined nor stake-debited, however slow it served."""
+    n, port = 4, 15400
+    victim = 1
+    plan = FaultPlan(slow_node=victim, slow_factor=4.0,
+                     slow_service_s=0.05)
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port, fault_plan=plan,
+                                 adaptive_deadlines=True,
+                                 deadline_floor_s=1.0,
+                                 max_iterations=4,
+                                 secure_agg=True, verification=True))
+                  for i in range(n)]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    agents, results = asyncio.run(go())
+    real = _settled_prefix_equal(results, min_common=2)
+    assert real >= 1, "a slow fleet must still mint real blocks"
+    for r in results:
+        if r["node"] == victim:
+            assert r["telemetry"]["stragglers"]["profile"]["slowed"]
+            continue
+        h = r["telemetry"]["health"].get(str(victim), {})
+        assert h.get("opens", 0) == 0, \
+            f"honest straggler was quarantined: {h}"
+        assert h.get("state", "closed") == "closed"
+    # stake: the slow peer was never debited below its genesis stake
+    # (debits are verification evidence only — docs/STRAGGLERS.md)
+    stake = agents[0].chain.latest_stake_map()
+    assert stake.get(victim, 0) >= agents[0].cfg.default_stake
+    # the straggler plane is scrape-visible: obs merges the slow table
+    merged = obs.merge_snapshots([r["telemetry"] for r in results])
+    assert any(row["node"] == victim
+               for row in merged["stragglers"]["slow_peers"])
+
+
+@pytest.mark.straggler
+def test_adaptive_deadline_advances_round_past_dead_leader():
+    """The headline scenario (ISSUE acceptance): warm a 4-node adaptive
+    cluster, hard-kill the current leader miner mid-run, and assert the
+    next round advances in a small multiple of the typical round time —
+    far under the fixed block_s the seed schedule would ride. Condition-
+    driven throughout (wait_until on observed heights)."""
+    n, port = 4, 15420
+    block_s = 45.0
+    slow_t = Timeouts(update_s=10.0, block_s=block_s, krum_s=4.0,
+                      share_s=10.0, rpc_s=4.0)
+
+    async def _hard_stop(agent, task):
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+        agent.pool.close()
+        await agent.server.stop()
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port, timeouts=slow_t,
+                                 adaptive_deadlines=True,
+                                 deadline_floor_s=1.5,
+                                 max_iterations=12))
+                  for i in range(n)]
+        tasks = [asyncio.ensure_future(a.run()) for a in agents]
+
+        # warm-up: the controller needs min_samples block observations
+        await wait_until(lambda: agents[0].iteration >= 4,
+                         what="controller warm-up height")
+        # kill whoever leads the CURRENT round (keep agent 0 as the
+        # measuring observer; if 0 leads, wait for a round led by
+        # another peer — stake-elected leaders rotate)
+        def leader_now():
+            _, miners, _, _ = agents[0].role_map.committee()
+            return max(miners) if miners else 0
+
+        await wait_until(lambda: leader_now() != 0,
+                         what="a non-anchor leader round")
+        victim = leader_now()
+        h_kill = agents[0].iteration
+        await _hard_stop(agents[victim], tasks[victim])
+        t0 = time.monotonic()
+        await wait_until(lambda: agents[0].iteration > h_kill,
+                         budget=block_s,
+                         what="round advance past the dead leader")
+        advance_s = time.monotonic() - t0
+
+        survivors = [a for a in agents if a.id != victim]
+        results = await asyncio.gather(
+            *(tasks[a.id] for a in survivors))
+        return agents, survivors, results, victim, advance_s
+
+    agents, survivors, results, victim, advance_s = asyncio.run(go())
+    # the dead-leader round advanced WELL under the fixed 45 s block
+    # deadline: adaptive budget ~= a few typical (sub-second) rounds
+    assert advance_s < block_s / 3, \
+        f"dead-leader round took {advance_s:.1f}s of block_s={block_s}"
+    _settled_prefix_equal(results, min_common=3)
+    # at least one survivor demonstrably tightened a deadline
+    assert any(r["counters"].get("deadline_adaptive", 0) > 0
+               for r in results)
+
+
+# ------------------------------------------- acceptance run (slow, heavy)
+
+
+@pytest.mark.slow
+@pytest.mark.straggler
+def test_slow_fleet_acceptance_mnist_tee():
+    """ISSUE acceptance shape: a live mnist cluster with ~20% of peers
+    on the 4x tee profile, secure-agg + verification, adaptive
+    deadlines ON — converging rounds with chains equal on the settled
+    prefix, zero breaker opens and zero stake debits against honest
+    stragglers, straggler/deadline readouts visible in the merged obs
+    table."""
+    n, port = 10, 15440
+    # roomy ceilings (the adaptive controller tightens them): a 10-peer
+    # mnist secure-agg round with 4x tee workers needs more than the
+    # 4 s harness share window to land its first real block
+    roomy = Timeouts(update_s=20.0, block_s=45.0, krum_s=6.0,
+                     share_s=20.0, rpc_s=8.0)
+    # seed drawn so the tee preset slows exactly 2/10 peers (pure
+    # function — the scan is deterministic)
+    seed = next(s for s in range(500)
+                if len(FaultPlan(seed=s, slow=0.2,
+                                 slow_preset="tee").slow_table(n)) == 2)
+    plan = FaultPlan(seed=seed, slow=0.2, slow_preset="tee")
+    slow_ids = set(plan.slow_table(n))
+
+    async def go():
+        agents = [PeerAgent(_cfg(i, n, port, dataset="mnist",
+                                 fault_plan=plan, secure_agg=True,
+                                 verification=True, batch_size=10,
+                                 timeouts=roomy,
+                                 adaptive_deadlines=True,
+                                 deadline_floor_s=1.0,
+                                 max_iterations=5))
+                  for i in range(n)]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    agents, results = asyncio.run(go())
+    real = _settled_prefix_equal(results, min_common=3)
+    assert real >= 2
+    for r in results:
+        for sid in slow_ids:
+            if r["node"] == sid:
+                continue
+            h = r["telemetry"]["health"].get(str(sid), {})
+            assert h.get("opens", 0) == 0, \
+                f"tee peer {sid} quarantined by {r['node']}: {h}"
+    stake = agents[0].chain.latest_stake_map()
+    for sid in slow_ids:
+        assert stake.get(sid, 0) >= agents[0].cfg.default_stake, \
+            f"honest tee peer {sid} was stake-debited"
+    merged = obs.merge_snapshots([r["telemetry"] for r in results])
+    assert len(merged["stragglers"]["slow_peers"]) == 2
+    assert merged["stragglers"]["adaptive_peers"] == n
+    table = obs.format_table(merged)
+    assert "stragglers:" in table and "waiting-on" in table
