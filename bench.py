@@ -72,21 +72,12 @@ def _progress(msg):
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
-def wire_round_bytes(cfg, w64, accepted, codec="raw64"):
-    """Cluster-wide protocol bytes for ONE round, measured by encoding
-    the actual frames (runtime/wire.py packers + messages.py codec path)
-    with `w64` as the representative delta/model vector:
-
-        num_samples × (num_verifiers × verify + num_miners × submit)
-      + (num_nodes − 1) × block broadcast
-
-    Lossy codecs are applied the way the live runtime applies them —
-    transform BEFORE packing (lossy-before-commit), so the frame sizes
-    here are exactly what the wire plane produces. Crypto tensors
-    (shares, blinds, VSS commitments) are sized from the config and
-    always travel lossless, which is why secure-agg rows compress less
-    than their plain-mode cousins: the crypto dominates and is
-    incompressible by design."""
+def _round_frame_bytes(cfg, w64, accepted, codec="raw64"):
+    """Per-frame byte sizes (verify, submit, block) for one round,
+    measured by encoding the ACTUAL frames (runtime/wire.py packers +
+    messages.py codec path) with `w64` as the representative
+    delta/model vector — the shared kernel of wire_round_bytes and
+    cross_host_round_bytes."""
     import numpy as np
 
     from biscotti_tpu.ledger.block import Block, BlockData, Update
@@ -138,10 +129,65 @@ def wire_round_bytes(cfg, w64, accepted, codec="raw64"):
                 stake_map={i: 10 for i in range(cfg.num_nodes)}).seal()
     bmeta, barrays = rwire.pack_block(blk)
     block = len(msgs.encode("RegisterBlock", bmeta, barrays, **kw))
+    return verify, submit, block
 
+
+def wire_round_bytes(cfg, w64, accepted, codec="raw64"):
+    """Cluster-wide protocol bytes for ONE round:
+
+        num_samples × (num_verifiers × verify + num_miners × submit)
+      + (num_nodes − 1) × block broadcast
+
+    Lossy codecs are applied the way the live runtime applies them —
+    transform BEFORE packing (lossy-before-commit), so the frame sizes
+    here are exactly what the wire plane produces. Crypto tensors
+    (shares, blinds, VSS commitments) are sized from the config and
+    always travel lossless, which is why secure-agg rows compress less
+    than their plain-mode cousins: the crypto dominates and is
+    incompressible by design."""
+    verify, submit, block = _round_frame_bytes(cfg, w64, accepted,
+                                               codec=codec)
     n_s = cfg.num_samples
     return int(n_s * (cfg.num_verifiers * verify + cfg.num_miners * submit)
                + (cfg.num_nodes - 1) * block)
+
+
+def cross_host_round_bytes(cfg, w64, accepted, codec="raw64", hosts=2,
+                           overlay=False):
+    """CROSS-HOST bytes for one round on an `hosts`-host hive fleet
+    (peers split evenly, the pod_launch layout): only frames whose two
+    ends sit on different hosts count — intra-host traffic rides the
+    hive loopback. Frame sizes come from the same real encoders as
+    wire_round_bytes; host-crossing fractions are the even-spread
+    estimate ((hosts−1)/hosts of a uniform fan-out crosses).
+
+    overlay=True prices the aggregation tree (docs/OVERLAY.md): verify
+    traffic is unchanged (point-to-point by design); secure-agg share
+    fan-out collapses to one aggregate per (subtree, miner); plain-mode
+    update fan-out crosses once per remote miner-holding subtree and
+    the block broadcast once per remote subtree instead of once per
+    remote peer."""
+    verify, submit, block = _round_frame_bytes(cfg, w64, accepted,
+                                               codec=codec)
+    n = cfg.num_nodes
+    n_s = cfg.num_samples
+    m = cfg.num_miners
+    v = cfg.num_verifiers
+    h = max(1, int(hosts))
+    remote_frac = (h - 1) / h
+    if not overlay:
+        return int(remote_frac * (n_s * (v * verify + m * submit)
+                                  + (n - 1) * block))
+    cross = remote_frac * n_s * v * verify  # verdict traffic: unchanged
+    if cfg.secure_agg:
+        # offers ride loopback; one aggregate (≈ one submit frame — the
+        # summed tensors have identical shapes) per (subtree, miner)
+        cross += remote_frac * h * m * submit
+    else:
+        # one relayed copy per remote host holding >= 1 miner
+        cross += n_s * min(m, h - 1) * submit
+    cross += (h - 1) * block  # one block crossing per remote subtree
+    return int(cross)
 
 
 def bench_config(name, cfg, device_iters=10, metrics=None):
@@ -310,16 +356,32 @@ def bench_config(name, cfg, device_iters=10, metrics=None):
     # compute (ISSUE 4; NET-SA's bottleneck axis)
     wire_raw = wire_round_bytes(cfg, delta, accepted, codec="raw64")
     wire_f32z = wire_round_bytes(cfg, delta, accepted, codec="f32+zlib")
+    # overlay headline row (docs/OVERLAY.md): TCP-crossing bytes/round on
+    # a 2-host hive fleet, flat fan-out vs the aggregation tree — the
+    # claim is read straight off the artifact instead of hand-derived
+    xh_flat = cross_host_round_bytes(cfg, delta, accepted, hosts=2,
+                                     overlay=False)
+    xh_overlay = cross_host_round_bytes(cfg, delta, accepted, hosts=2,
+                                        overlay=True)
     row.update({
         "wire_bytes_per_round": wire_raw,
         "wire_bytes_per_round_f32_zlib": wire_f32z,
         "wire_compression_x": round(wire_raw / max(1, wire_f32z), 2),
+        "cross_host_bytes_per_round": xh_flat,
+        "cross_host_bytes_per_round_overlay": xh_overlay,
+        "overlay_cross_host_saving_x": round(
+            xh_flat / max(1, xh_overlay), 2),
     })
     if metrics is not None:
         g = metrics.gauge("biscotti_bench_wire_bytes_per_round",
                           "bench cluster gossip bytes per round")
         g.set(wire_raw, config=name, codec="raw64")
         g.set(wire_f32z, config=name, codec="f32+zlib")
+        gx = metrics.gauge(
+            "biscotti_bench_cross_host_bytes_per_round",
+            "bench TCP-crossing bytes per round on a 2-host hive fleet")
+        gx.set(xh_flat, config=name, overlay="off")
+        gx.set(xh_overlay, config=name, overlay="on")
     if metrics is not None:
         # every component lands on the telemetry plane too, as one
         # histogram family labeled (config, phase) — rendered to

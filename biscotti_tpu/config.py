@@ -260,6 +260,26 @@ class BiscottiConfig:
     speculation: bool = False
     batch_intake: bool = False
 
+    # --- hierarchical aggregation overlay (runtime/overlay.py,
+    # docs/OVERLAY.md) ---
+    # overlay=True arms the committee-rooted aggregation tree on the wire
+    # plane: peers group into contiguous id blocks of `overlay_group`
+    # (the pod_launch --peers-per-host layout, so the leaf->interior hop
+    # is loopback on a hive deployment), a seed-derived per-round relay
+    # per group pre-aggregates secure-agg share fan-out (summed share
+    # rows + homomorphically summed Pedersen commitment grids, one
+    # RegisterAggregate per miner per subtree) and deduplicates
+    # plain-mode update fan-out and block broadcast (RelayFrames, one
+    # frame per remote subtree). Per-update verification traffic stays
+    # point-to-point; a missing relay degrades to direct delivery within
+    # the round. Default OFF = the seed's flat fan-out, bit-identical
+    # traffic schedule (guarded by tests/test_overlay.py).
+    overlay: bool = False
+    # peers per overlay group (the first interior tree level); the hive
+    # launcher defaults it to its own co-hosted span, pod_launch to
+    # --peers-per-host. Required >= 2 when overlay is on.
+    overlay_group: int = 0
+
     # --- wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md) ---
     # negotiated payload codec for protocol traffic: "raw64" (legacy
     # float64 frames, the default), "f32"/"bf16" (downcast — applied to
@@ -383,6 +403,17 @@ class BiscottiConfig:
             raise ValueError("deadline_floor_s must be > 0")
         if self.snapshot_tail < 1:
             raise ValueError("snapshot_tail must be >= 1")
+        # the overlay needs a real subtree to aggregate over — an armed
+        # flag without a group would silently run the flat fan-out
+        # labeled as an overlay run; refuse the dead configuration
+        # (hive/pod_launch auto-fill the group from their host layout)
+        if self.overlay and self.overlay_group < 2:
+            raise ValueError(
+                "overlay=True requires overlay_group >= 2 (peers per "
+                "aggregation subtree; the hive launcher defaults it to "
+                "its co-hosted span — docs/OVERLAY.md)")
+        if self.overlay_group < 0:
+            raise ValueError("overlay_group must be >= 0")
 
     # ------------------------------------------------------------------ derived
 
@@ -645,6 +676,18 @@ class BiscottiConfig:
                        help="1 verifies plain-mode miner intake as one "
                             "batched RLC commitment check per "
                             "micro-batch, bisection on failure")
+        p.add_argument("--overlay", type=int,
+                       default=int(BiscottiConfig.overlay),
+                       help="1 arms the hierarchical aggregation overlay "
+                            "(committee-rooted per-round tree: share "
+                            "fan-out pre-aggregated per subtree, update/"
+                            "block fan-out relayed once per remote "
+                            "subtree; docs/OVERLAY.md). 0 = the seed's "
+                            "flat fan-out, bit-identical")
+        p.add_argument("--overlay-group", type=int,
+                       default=BiscottiConfig.overlay_group,
+                       help="peers per overlay subtree (contiguous ids; "
+                            "match --peers-per-host on a hive fleet)")
         p.add_argument("--wire-codec", type=str,
                        default=BiscottiConfig.wire_codec,
                        help="payload codec for protocol traffic "
@@ -718,6 +761,8 @@ class BiscottiConfig:
             pipeline_depth=getattr(ns, "pipeline_depth", cls.pipeline_depth),
             speculation=bool(getattr(ns, "speculation", cls.speculation)),
             batch_intake=bool(getattr(ns, "batch_intake", cls.batch_intake)),
+            overlay=bool(getattr(ns, "overlay", cls.overlay)),
+            overlay_group=getattr(ns, "overlay_group", cls.overlay_group),
             wire_codec=getattr(ns, "wire_codec", cls.wire_codec),
             wire_chunk_bytes=getattr(ns, "wire_chunk_bytes",
                                      cls.wire_chunk_bytes),

@@ -1055,6 +1055,23 @@ def sum_blind_rows(blind_rows: Sequence[np.ndarray]) -> List[List[int]]:
     return out
 
 
+def sum_blind_row_tensors(blind_rows: Sequence[np.ndarray]) -> np.ndarray:
+    """sum_blind_rows, repacked to the wire-tensor form: scalar sum
+    (mod q) of [S, C, 32] blind-row tensors returned as the same uint8
+    [S, C, 32] layout — the blinding tensor of an aggregated share
+    slice, ready to travel in an overlay aggregate frame or feed
+    vss_verify_multi directly."""
+    sums = sum_blind_rows(blind_rows)
+    s = len(sums)
+    c = len(sums[0]) if sums else 0
+    out = np.zeros((s, c, 32), np.uint8)
+    for si in range(s):
+        for ci in range(c):
+            out[si, ci] = np.frombuffer(
+                int(sums[si][ci]).to_bytes(32, "little"), np.uint8)
+    return out
+
+
 def commitment_eval_xy(comms: np.ndarray, x: int) -> Optional[List[ed.Point]]:
     """Homomorphic evaluation of every chunk's committed polynomial at
     share point `x`: [C, k, 64] grid → one point per chunk,

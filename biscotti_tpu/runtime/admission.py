@@ -80,6 +80,15 @@ _MSG_CLASS: Dict[str, str] = {
     # round-critical update class
     "GetSnapshot": BULK,
     "GetReshareDeal": BULK,
+    # hierarchical aggregation overlay (runtime/overlay.py,
+    # docs/OVERLAY.md): offers carry a worker's FULL share/blind/
+    # commitment tensors, aggregates a whole subtree's sums, and relay
+    # frames fan a block/update out — all multi-payload bodies. Classed
+    # bulk so a hot interior node SHEDS overlay load (the sender then
+    # degrades to the seed's direct delivery) instead of melting.
+    "OverlayOffer": BULK,
+    "RegisterAggregate": BULK,
+    "RelayFrames": BULK,
     "AdvertiseBlock": CONTROL,
     "RegisterDecline": CONTROL,
     "GetUpdateList": CONTROL,

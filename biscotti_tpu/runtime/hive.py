@@ -690,6 +690,12 @@ def main(argv=None) -> int:
                       os.path.join(repo, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    if getattr(ns, "overlay", 0) and not getattr(ns, "overlay_group", 0):
+        # default the aggregation subtree to this hive's co-hosted span —
+        # the intra-hive pre-aggregation seam (docs/OVERLAY.md): one
+        # interior node per host, leaf->relay offers ride loopback
+        ns.overlay_group = (int(ns.local.split(":")[1]) if ns.local
+                            else ns.num_nodes)
     cfg = BiscottiConfig.from_args(ns)
     cfg = cfg.replace(
         max_iterations=ns.iterations, convergence_error=0.0,
@@ -721,6 +727,15 @@ def main(argv=None) -> int:
     dumps = [r["chain_dump"] for r in results]
     digests = [hashlib.sha256(d.encode()).hexdigest() for d in dumps]
     anchor = results[0]
+    # wire accounting over THIS hive's peers (obs.merge_wire — the one
+    # definition): cross-host (TCP-crossing) vs loopback-avoided bytes,
+    # so the overlay headline reads straight off the pod_launch artifact
+    from biscotti_tpu.tools import obs as _obs
+
+    wire = _obs.merge_wire([r.get("telemetry", {}) for r in results])
+    rounds = max(1, len(dumps[0].splitlines()) - 1)
+    overlay_tbl = _obs.merge_overlay([r.get("telemetry", {})
+                                      for r in results])
     rows = [tuple(x.split(",")) for x in anchor["logs"]]
     if len(rows) >= 2:
         ts = [float(r[2]) for r in rows]
@@ -746,6 +761,17 @@ def main(argv=None) -> int:
         "batch_device": hive.stepper is not None,
         "batch_fallback": hive.stepper_fallback or None,
         "loopback": not ns.no_loopback,
+        "overlay": bool(cfg.overlay),
+        "cross_host_bytes": wire["cross_host_bytes"],
+        "cross_host_by_msg_type": dict(sorted(
+            wire["out_by_msg_type"].items(), key=lambda kv: -kv[1])[:10]),
+        "cross_host_bytes_per_round": round(
+            wire["cross_host_bytes"] / rounds, 1),
+        "loopback_avoided_bytes_per_round": round(
+            wire["loopback_bytes"] / rounds, 1),
+        "overlay_aggregated": overlay_tbl["aggregated"],
+        "overlay_relayed": overlay_tbl["relayed"],
+        "overlay_fallback": overlay_tbl["fallback"],
         "sgd_batches": hive.stepper.batches if hive.stepper else None,
         "final_error": anchor.get("final_error"),
     }

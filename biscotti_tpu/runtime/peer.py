@@ -51,6 +51,7 @@ from biscotti_tpu.parallel.sim import _poisoned_ids
 from biscotti_tpu.runtime import admission as adm
 from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
+from biscotti_tpu.runtime import overlay as ov
 from biscotti_tpu.runtime import stragglers
 from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import BusyError, RPCError, StaleError
@@ -173,6 +174,22 @@ class RoundState:
     plain_pending: List[Tuple[Update, asyncio.Future]] = field(
         default_factory=list)
     plain_drainer: Optional[asyncio.Task] = None
+    # hierarchical aggregation overlay (cfg.overlay, docs/OVERLAY.md) —
+    # MINER side: whole-subtree aggregates accepted via
+    # RegisterAggregate. A group entry holds the summed share-row slice,
+    # the homomorphically summed commitment grid, and the summed blind
+    # tensor; miner_group_of maps each member sid to its group so the
+    # mint/serve paths treat a subtree as one atomic intake component
+    # (servable whole or not at all — the group sum cannot be subset).
+    miner_groups: Dict[frozenset, Dict] = field(default_factory=dict)
+    miner_group_of: Dict[int, frozenset] = field(default_factory=dict)
+    # RELAY side: co-hosted workers' OverlayOffer payloads buffered until
+    # the flush (all expected leaves offered, or the window expired);
+    # flushed sids are remembered so a late wave aggregates separately
+    # instead of double-counting
+    relay_offers: Dict[int, Dict] = field(default_factory=dict)
+    relay_flushed: Set[int] = field(default_factory=set)
+    relay_task: Optional[asyncio.Task] = None
     block_done: Optional[asyncio.Event] = None
     tasks: List[asyncio.Task] = field(default_factory=list)
 
@@ -266,6 +283,17 @@ class PeerAgent:
         # always bit-exact and all crypto survives compression.
         self.wire = wcodecs.get(cfg.wire_codec)
         self.caps = wcodecs.capabilities(cfg.wire_codec)
+        # hierarchical aggregation overlay (runtime/overlay.py,
+        # docs/OVERLAY.md): the deterministic per-round tree this peer
+        # routes bulk fan-out through. Inactive (seed-identical flat
+        # fan-out) unless cfg.overlay armed a real group size.
+        self.overlay = ov.Router.from_config(cfg)
+        # relay flush window: how long an interior node waits for the
+        # rest of its subtree's offers before shipping a partial
+        # aggregate (late offers aggregate as a second wave) — scaled
+        # off the share deadline so fast-timeout harness clusters flush
+        # promptly while production keeps a wide batching window
+        self.overlay_window_s = min(2.0, self.timeouts.share_s / 8)
         self.peer_caps: Dict[int, frozenset] = {}
         # top-k error-feedback residual (what sparsification dropped,
         # fed forward into next round's delta) — per-peer state: each
@@ -496,6 +524,12 @@ class PeerAgent:
         reg.gauge("biscotti_speculation_discards",
                   "speculative worker steps discarded on fork/mismatch").set(
             self.counters.get("speculation_discard", 0))
+        # overlay plane (docs/OVERLAY.md): tree shape of the armed
+        # aggregation overlay — flat (depth 1) when disabled
+        if self.overlay.enabled:
+            reg.gauge(ov.DEPTH_GAUGE, ov.DEPTH_HELP).set(self.overlay.depth)
+            reg.gauge(ov.SUBTREE_GAUGE, ov.SUBTREE_HELP).set(
+                len(self.overlay.members(self.overlay.gid_of(self.id))))
         # membership plane (docs/MEMBERSHIP.md): this peer's view of who
         # is in, and how many times that view has changed
         reg.gauge("biscotti_membership_epoch",
@@ -566,6 +600,30 @@ class PeerAgent:
             # lag) the obs CLI groups its per-host columns by. None for
             # a standalone agent.
             "hive": dict(self.hive_info) if self.hive_info else None,
+            # aggregation-overlay readout (docs/OVERLAY.md): tree shape
+            # plus this peer's aggregated/relayed/fallback tallies — the
+            # obs overlay table and the chaos report's `overlay` key
+            # merge exactly this
+            "overlay": {
+                "enabled": self.overlay.enabled,
+                "group_size": self.overlay.group,
+                "depth": self.overlay.depth,
+                "aggregated": self.counters.get(
+                    "overlay_aggregate_registered", 0),
+                "aggregates_sent": self.counters.get(
+                    "overlay_aggregate_sent", 0),
+                "offers": (self.counters.get("overlay_offer_sent", 0)
+                           + self.counters.get("overlay_offer_local", 0)),
+                "relayed": self.counters.get("overlay_relayed_sent", 0),
+                "forwarded": self.counters.get(
+                    "overlay_relay_forwarded", 0),
+                "direct": (self.counters.get("overlay_offer_fallback", 0)
+                           + self.counters.get("overlay_relay_fallback",
+                                               0)),
+                "fallback": (self.counters.get(
+                    "overlay_aggregate_refused", 0)
+                    + self.counters.get("overlay_fallback_forwarded", 0)),
+            },
         }
 
     async def _h_metrics(self, meta, arrays):
@@ -1069,6 +1127,10 @@ class PeerAgent:
             "GetSnapshot": self._h_get_snapshot,
             "GetReshareDeal": self._h_get_reshare_deal,
             "Metrics": self._h_metrics,
+            # hierarchical aggregation overlay (docs/OVERLAY.md)
+            "OverlayOffer": self._h_overlay_offer,
+            "RegisterAggregate": self._h_register_aggregate,
+            "RelayFrames": self._h_relay_frames,
         }
         h = dispatch.get(msg_type)
         if h is None:
@@ -1207,6 +1269,27 @@ class PeerAgent:
 
         async def pull():
             try:
+                if self.overlay.enabled:
+                    # overlay pull backoff (docs/OVERLAY.md): with the
+                    # tree armed, our subtree's relay is most likely
+                    # mid-forward of this very body — an instant pull
+                    # would re-fetch it cross-host and undo the
+                    # deduplication (observed as a GetBlock.reply storm
+                    # when the minter's OWN hive advertises over
+                    # loopback before the remote relay finishes its 50
+                    # co-hosted deliveries). Poll the chain for a
+                    # bounded window, jittered so expiring waiters don't
+                    # stampede; a dead relay costs a few seconds of
+                    # extra latency, never the round.
+                    deadline = (time.monotonic() + 3.0
+                                + 1.5 * self._rng.random())
+                    while time.monotonic() < deadline:
+                        have2 = self.chain.get_block(it)
+                        if have2 is not None and have2.hash == h:
+                            return
+                        if self.iteration > it:
+                            return
+                        await asyncio.sleep(0.25)
                 bmeta, barrays = await self._call(
                     src, "GetBlock",
                     {"iteration": it, **self._reply_codec_meta(src)},
@@ -1540,10 +1623,22 @@ class PeerAgent:
             loopback_pids = frozenset(
                 pid for pid in targets
                 if self.pool.loopback_endpoint(*self.peers[pid]) is not None)
+            # overlay down-path (docs/OVERLAY.md): remote targets sharing
+            # a subtree get the block THROUGH that subtree's relay — the
+            # multi-MB body crosses TCP once per remote subtree instead
+            # of once per remote peer; a failed relay falls back to the
+            # direct pushes below for exactly its orphaned targets
+            relayed_plan: Dict[int, List[int]] = {}
+            if self.overlay.enabled:
+                _, relayed_plan = self.overlay.plan(
+                    [p for p in targets if p not in loopback_pids],
+                    blk.iteration, self.id)
+            relayed_pids = frozenset(t for ts in relayed_plan.values()
+                                     for t in ts)
             frames: Dict[Tuple[str, int], Tuple[bytes, str]] = {}
             group: Dict[int, Tuple[str, int]] = {}
             for pid in targets:
-                if pid in loopback_pids:
+                if pid in loopback_pids or pid in relayed_pids:
                     continue
                 key = self._wire_to(pid)
                 group[pid] = key
@@ -1591,8 +1686,20 @@ class PeerAgent:
             # gossip outlives the round on purpose (stragglers still need
             # the block); _bg_tasks holds the strong ref and the bounded
             # send in rpc.py caps each task's lifetime at rpc_s
+            loop_now = asyncio.get_running_loop()
+            # relay frames FIRST: the remote subtrees' forwards race the
+            # advert re-gossip our own loopback deliveries will trigger,
+            # so the cross-host copies get the head start
+            for relay, ts in relayed_plan.items():
+                t = loop_now.create_task(self._relay_send(
+                    relay, "RegisterBlock", meta, arrays, ts,
+                    blk.iteration, timeout=self.timeouts.rpc_s))
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
             for pid in targets:
-                t = asyncio.get_running_loop().create_task(push(pid))
+                if pid in relayed_pids:
+                    continue
+                t = loop_now.create_task(push(pid))
                 self._bg_tasks.add(t)
                 t.add_done_callback(self._bg_tasks.discard)
             return
@@ -1849,7 +1956,8 @@ class PeerAgent:
         if not self.role_map.is_miner(self.id):
             raise RPCError("not a miner this round")
         sid = int(meta["source_id"])
-        if sid in st.miner_shares or sid in st.miner_rejected:
+        if sid in st.miner_shares or sid in st.miner_rejected \
+                or sid in st.miner_group_of:
             return {}, {}
         rows = np.asarray(arrays.get("share_rows", np.zeros(0)), dtype=np.int64)
         expect = (self.cfg.shares_per_miner,
@@ -2074,6 +2182,42 @@ class PeerAgent:
         sl = ss.miner_rows(self.cfg.total_shares, idx, len(miners))
         return self._xs_all[sl]
 
+    def _sec_sources(self, st: RoundState) -> Set[int]:
+        """Every sid whose shares this miner holds — directly registered
+        plus members of accepted overlay subtree aggregates."""
+        return set(st.miner_shares) | set(st.miner_group_of)
+
+    def _sec_decompose(self, st: RoundState, nodes: Sequence[int]):
+        """Decompose an aggregation set into its intake COMPONENTS:
+        whole overlay subtree aggregates plus direct sids. Returns
+        (rows_list, rec_list) where rows_list holds each component's
+        share-row slice and rec_list its (comms, blinds) VSS record
+        (None for keyless direct intake) — summation over components
+        equals the seed's per-sid summation by associativity, so
+        aggregates, reshare deals, and recovered updates are
+        bit-identical to the flat path. Returns None when `nodes`
+        splits a subtree (the group sum cannot be subset) or names a
+        sid this miner does not hold."""
+        remaining = set(int(n) for n in nodes)
+        rows: List[np.ndarray] = []
+        recs: List = []
+        for g, rec in st.miner_groups.items():
+            inter = g & remaining
+            if not inter:
+                continue
+            if inter != g:
+                return None
+            rows.append(rec["rows"])
+            recs.append((rec["comms"], rec["blinds"]))
+            remaining -= g
+        for n in sorted(remaining):
+            r = st.miner_shares.get(n)
+            if r is None:
+                return None
+            rows.append(r)
+            recs.append(st.miner_vss_records.get(n))
+        return rows, recs
+
     async def _verify_intake(self, st: RoundState,
                              finalize: bool = True) -> None:
         """Round-batched VSS verification of every pending share slice: one
@@ -2234,6 +2378,24 @@ class PeerAgent:
         like any intake failure."""
         if st.my_xs is None or not self.cfg.secure_agg:
             return True
+        # overlay subtree aggregates are servable only WHOLE — the group
+        # sum cannot be subset. A set that splits one drops the whole
+        # subtree from the servable intake (a state gap like the
+        # missing-records path below, never verification evidence: no
+        # debit) so callers that shrink the set and retry always make
+        # progress. Fully-covered groups pass through: their batch
+        # (== their membership) is inside `nodes`, the exact condition
+        # the aggregated intake check is sound for.
+        nset = set(nodes)
+        for g in list(st.miner_groups):
+            inter = g & nset
+            if inter and inter != g:
+                st.miner_groups.pop(g, None)
+                for sid in g:
+                    st.miner_group_of.pop(sid, None)
+                    st.miner_vss_batch.pop(sid, None)
+                self._trace("overlay_group_dropped", n=len(g))
+                return False
         pending = partial_batch_members(st.miner_vss_batch, nodes)
         if not pending:
             return True
@@ -2439,7 +2601,7 @@ class PeerAgent:
         st = await self._wait_round_ready(it, budget=self.timeouts.rpc_s / 2)
         self._check_leader_request("update-list", it, [], meta)
         await self._verify_intake(st)
-        srcs = sorted(st.miner_shares)
+        srcs = sorted(self._sec_sources(st))
         return {"sources": srcs, "rejected": sorted(st.miner_rejected)}, {}
 
     async def _h_get_miner_part(self, meta, arrays):
@@ -2457,9 +2619,10 @@ class PeerAgent:
         if len(set(nodes)) != len(nodes):
             # [v, v] would pass the size floor yet aggregate to 2·share_v
             raise RPCError("duplicate nodes in aggregation set")
-        if not all(n in st.miner_shares for n in nodes):
+        srcs = self._sec_sources(st)
+        if not all(n in srcs for n in nodes):
             raise RPCError("missing shares for requested nodes")
-        if len(nodes) < min(2, len(st.miner_shares)):
+        if len(nodes) < min(2, len(srcs)):
             raise RPCError("aggregation set below privacy floor")
         if st.served_part is not None and st.served_part != sorted(nodes):
             raise RPCError("a different aggregation set was already served")
@@ -2475,8 +2638,11 @@ class PeerAgent:
         # set-agreement round among miners.
         if not await self._ensure_subset_consistent(st, nodes):
             raise RPCError("aggregation set fails VSS re-check")
+        decomp = self._sec_decompose(st, nodes)
+        if decomp is None:
+            raise RPCError("aggregation set splits an overlay subtree")
         st.served_part = sorted(nodes)
-        stack = np.stack([st.miner_shares[n] for n in nodes])
+        stack = np.stack(decomp[0])
         agg = np.asarray(ss.aggregate_shares(stack))
         return {"nodes": nodes}, {"agg_rows": agg}
 
@@ -2500,10 +2666,10 @@ class PeerAgent:
         any recipient verify the deal homomorphically against the
         ORIGINAL workers' commitments, no dealer anywhere. Runs off the
         event loop (O(R·C·k) fixed-base commits)."""
-        stack = np.stack([st.miner_shares[n] for n in nodes])
-        agg_rows = np.asarray(ss.aggregate_shares(stack))  # [R, C]
+        rows_c, recs_c = self._sec_decompose(st, nodes)
+        agg_rows = np.asarray(ss.aggregate_shares(np.stack(rows_c)))  # [R, C]
         agg_blinds = cm.sum_blind_rows(
-            [st.miner_vss_records[n][1] for n in nodes])   # [R][C] ints
+            [rec[1] for rec in recs_c])                    # [R][C] ints
         ctx = self._reshare_context(it)
         coeffs = ss.reshare_coeffs(agg_rows, self.cfg.poly_size,
                                    self.schnorr_seed, ctx)
@@ -2553,17 +2719,20 @@ class PeerAgent:
             # hostile far-out points would blow the exact-int64 bound of
             # the sub-share evaluation (ops/secretshare.RESHARE_COEF_BOUND)
             raise RPCError("reshare points outside the exactness bound")
-        if not all(n in st.miner_shares for n in nodes):
+        srcs = self._sec_sources(st)
+        if not all(n in srcs for n in nodes):
             raise RPCError("missing shares for requested nodes")
-        if len(nodes) < min(2, len(st.miner_shares)):
+        if len(nodes) < min(2, len(srcs)):
             raise RPCError("aggregation set below privacy floor")
         if st.served_part is not None and st.served_part != sorted(nodes):
             raise RPCError("a different aggregation set was already served")
         if not await self._ensure_subset_consistent(st, nodes):
             raise RPCError("aggregation set fails VSS re-check")
-        if not all(n in st.miner_vss_records for n in nodes):
+        decomp = self._sec_decompose(st, nodes)
+        if decomp is None or any(rec is None for rec in decomp[1]):
             # plain hash-commitment mode (keyless) carries no VSS records
-            # to re-deal against — resharing is a secure-agg capability
+            # to re-deal against — resharing is a secure-agg capability —
+            # and an overlay-split set has no per-component records either
             raise RPCError("no VSS records to reshare")
         st.served_part = sorted(nodes)
         with self.tele.span("reshare_deal", it=it):
@@ -2621,11 +2790,11 @@ class PeerAgent:
         if len(reachable) * per < cfg.poly_size:
             self._trace("reshare_short", survivors=len(reachable))
             return None
-        grids = [st.miner_vss_records[n][0] for n in nodes
-                 if n in st.miner_vss_records]
-        if len(grids) != len(nodes):
+        decomp = self._sec_decompose(st, nodes)
+        if decomp is None or any(rec is None for rec in decomp[1]):
             self._trace("reshare_short", reason="missing vss records")
             return None
+        grids = [rec[0] for rec in decomp[1]]
         self._bump_epoch("reshare_round")
         xs_new = list(self._xs_all)
         with self.tele.span("reshare_verify", it=it):
@@ -2637,8 +2806,8 @@ class PeerAgent:
         rows_parts: List[np.ndarray] = []
         xs_parts: List[int] = []
         own_idx = miners.index(self.id)
-        stack = np.stack([st.miner_shares[n] for n in nodes])
-        rows_parts.append(np.asarray(ss.aggregate_shares(stack)))
+        rows_parts.append(np.asarray(ss.aggregate_shares(
+            np.stack(decomp[0]))))
         xs_parts.extend(self._xs_all[ss.miner_rows(cfg.total_shares,
                                                    own_idx, len(miners))])
         sig = self._sign(self._part_message(
@@ -2998,28 +3167,37 @@ class PeerAgent:
                 shares = np.asarray(ss.make_shares(
                     np.asarray(q), cfg.poly_size, cfg.total_shares))
             await self._slow_pad(time.monotonic() - t0_sh)
-            for idx, m in enumerate(sorted(miners)):
-                sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
-                try:
-                    await self._call(m, "RegisterSecret", {
-                        "iteration": it, "source_id": self.id,
-                        "miner_index": idx,
-                        "commitment": commitment.hex(),
-                        "signers": list(u.signers),
-                        "signatures": [s.hex() for s in u.signatures],
-                    }, self._secret_arrays(shares, blind_rows, comms, sl))
-                except Exception:
-                    pass
+            # overlay up-path (docs/OVERLAY.md): hand the full tensors to
+            # this round's subtree relay (loopback-free in a hive), which
+            # pre-aggregates the whole subtree into one frame per miner.
+            # Any failure falls through to the seed's direct fan-out.
+            sent = await self._overlay_submit_secret(
+                it, commitment, u, shares, blind_rows, comms)
+            if not sent:
+                for idx, m in enumerate(sorted(miners)):
+                    sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
+                    try:
+                        await self._call(m, "RegisterSecret", {
+                            "iteration": it, "source_id": self.id,
+                            "miner_index": idx,
+                            "commitment": commitment.hex(),
+                            "signers": list(u.signers),
+                            "signatures": [s.hex() for s in u.signatures],
+                        }, self._secret_arrays(shares, blind_rows, comms,
+                                               sl))
+                    except Exception:
+                        pass
         else:
             meta, arrays = wire.pack_update(u)
             meta["iteration"] = it
             # send to every miner: only the leader (max id) mints, so the
             # update must reach it (the reference's first-miner-wins race,
-            # main.go:1777-1845, maps onto our single-leader mint)
-            await asyncio.gather(*(
-                self._safe_call(m, "RegisterUpdate", meta, arrays)
-                for m in sorted(miners)
-            ))
+            # main.go:1777-1845, maps onto our single-leader mint). With
+            # the overlay armed, miners sharing a remote subtree receive
+            # the frame via that subtree's relay — one TCP crossing per
+            # subtree, direct fallback on relay failure.
+            await self._overlay_fanout("RegisterUpdate", meta, arrays,
+                                       sorted(miners), it)
         self._trace("update_sent", secure_agg=cfg.secure_agg)
 
     def _vss_build(self, q: np.ndarray, it: int,
@@ -3064,6 +3242,426 @@ class PeerAgent:
         except Exception:
             return False
 
+    # ------------------------------------------- aggregation overlay plane
+    # (runtime/overlay.py, docs/OVERLAY.md). Every method below is gated
+    # on the armed Router: with cfg.overlay off, none of these run and
+    # the round's traffic schedule is the seed's, bit for bit.
+
+    def _overlay_saved(self, frames_avoided: int, meta, arrays) -> None:
+        """Tick the bytes-saved estimate: `frames_avoided` copies of this
+        payload did NOT cross the wire because the tree deduplicated or
+        aggregated them."""
+        if frames_avoided <= 0:
+            return
+        self.tele.registry.counter(ov.SAVED_METRIC, ov.SAVED_HELP).inc(
+            frames_avoided * ov.frame_estimate(meta, arrays))
+
+    async def _overlay_submit_secret(self, it: int, commitment: bytes,
+                                     u: Update, shares: np.ndarray,
+                                     blind_rows: np.ndarray,
+                                     comms: np.ndarray) -> bool:
+        """Worker half of the secure-agg up-path: hand the FULL share /
+        blind / commitment tensors to this round's subtree relay in one
+        frame (loopback-free when co-hosted). Returns False — caller
+        falls back to the seed's per-miner fan-out — whenever the
+        overlay is off, the subtree is trivial, or the relay is
+        unreachable (the missing-interior-node degradation)."""
+        if not self.overlay.enabled:
+            return False
+        gid = self.overlay.gid_of(self.id)
+        workers = [n for n in self.overlay.members(gid)
+                   if self.role_map.is_vanilla(n)]
+        if len(workers) < 2:
+            # a lone contributor has nothing to combine with: the relay
+            # hop would add latency without deduplicating anything
+            return False
+        relay = self.overlay.relay(gid, it)
+        offer_meta = {
+            "iteration": it, "source_id": self.id,
+            "commitment": commitment.hex(),
+            "signers": list(u.signers),
+            "signatures": [s.hex() for s in u.signatures],
+        }
+        offer = {
+            "commitment": commitment.hex(),
+            "signers": list(u.signers),
+            "signatures": [s.hex() for s in u.signatures],
+            "shares": np.asarray(shares, np.int64),
+            "blinds": np.asarray(blind_rows, np.uint8),
+            "comms": np.asarray(comms, np.uint8),
+        }
+        if relay == self.id:
+            st = self.round
+            if st.iteration != it:
+                return False
+            self._relay_book_offer(st, self.id, offer)
+            self._trace("overlay_offer_local")
+            return True
+        try:
+            await self._call(relay, "OverlayOffer", offer_meta, {
+                "share_rows": offer["shares"],
+                "blind_rows": offer["blinds"],
+                "comms": offer["comms"],
+            })
+        except Exception as e:
+            self._trace("overlay_offer_fallback", relay=relay,
+                        error=type(e).__name__)
+            return False
+        self._trace("overlay_offer_sent", relay=relay)
+        return True
+
+    async def _h_overlay_offer(self, meta, arrays):
+        """Relay intake: one subtree leaf's full secure-agg tensors.
+        Only leaves of OUR subtree may offer, and only to the peer the
+        seed-derived rotation names relay this round; the digest binding
+        is checked here (cheap) so one garbage offer cannot poison — and
+        thereby fall back — the whole subtree's aggregate. Everything
+        else (signature quorums, the share-vs-commitment check) is the
+        MINER's job, exactly as on the direct path."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        st = await self._wait_round_ready(it)
+        if not (self.overlay.enabled and self.cfg.secure_agg):
+            raise RPCError("overlay aggregation disabled")
+        sid = int(meta["source_id"])
+        gid = self.overlay.gid_of(self.id)
+        if self.overlay.gid_of(sid) != gid or sid == self.id:
+            raise RPCError("offer outside this relay's subtree")
+        if self.overlay.relay(gid, it) != self.id:
+            raise RPCError("not this round's relay")
+        cfg = self.cfg
+        c = ss.num_chunks(self.trainer.num_params, cfg.poly_size)
+        shares = np.asarray(arrays.get("share_rows", np.zeros(0)), np.int64)
+        blinds = np.asarray(arrays.get("blind_rows", np.zeros(0)), np.uint8)
+        comms = np.asarray(arrays.get("comms", np.zeros(0)), np.uint8)
+        if shares.shape != (cfg.total_shares, c) \
+                or blinds.shape != (cfg.total_shares, c, 32) \
+                or comms.shape != (c, cfg.poly_size, 64):
+            raise RPCError("bad offer tensor shapes")
+        commitment = bytes.fromhex(meta.get("commitment", ""))
+        if cm.vss_digest(comms) != commitment:
+            raise RPCError("commitment digest mismatch")
+        self._relay_book_offer(st, sid, {
+            "commitment": meta.get("commitment", ""),
+            "signers": [int(x) for x in meta.get("signers", [])],
+            "signatures": [str(s) for s in meta.get("signatures", [])],
+            "shares": shares, "blinds": blinds, "comms": comms,
+        })
+        return {}, {}
+
+    def _relay_book_offer(self, st: RoundState, sid: int,
+                          offer: Dict) -> None:
+        if sid in st.relay_offers or sid in st.relay_flushed:
+            return  # duplicate offer: first wins, like miner intake
+        st.relay_offers[sid] = offer
+        self._relay_last_offer = asyncio.get_running_loop().time()
+        if st.relay_task is None or st.relay_task.done():
+            t = asyncio.get_running_loop().create_task(
+                self._relay_flush_loop(st))
+            st.relay_task = t
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
+
+    async def _relay_flush_loop(self, st: RoundState) -> None:
+        """Wait for the rest of the subtree's offers. Flush the moment
+        every expected leaf (this round's vanilla workers in the group)
+        is accounted for; otherwise flush once the offer burst stops
+        (no new offer for one debounce beat — the verifier releases all
+        approved workers at once, so offers arrive as one burst and a
+        leaf that DECLINED will simply never offer), with the window as
+        the hard cap. The debounce must stay well inside the miner's
+        post-quorum grace (~1 s): a relay waiting a full window for a
+        decliner would otherwise hold honest shares past the mint. Late
+        offers re-arm the loop and aggregate as their own wave (the
+        miner accepts disjoint groups)."""
+        loop = asyncio.get_running_loop()
+        grp = self.overlay.members(self.overlay.gid_of(self.id))
+        expected = {n for n in grp if self.role_map.is_vanilla(n)}
+        deadline = loop.time() + self.overlay_window_s
+        debounce_s = 0.25
+        try:
+            # outer loop: an offer booked WHILE a flush's RPCs are in
+            # flight sees relay_task still alive and arms no new task —
+            # it would be silently stranded unless this loop re-checks
+            # the buffer after every flush
+            while True:
+                while loop.time() < deadline:
+                    if self.round is not st or (st.block_done is not None
+                                                and st.block_done.is_set()):
+                        break
+                    if expected <= (st.relay_offers.keys()
+                                    | st.relay_flushed):
+                        break
+                    last = getattr(self, "_relay_last_offer", loop.time())
+                    if st.relay_offers and loop.time() - last >= debounce_s:
+                        break
+                    await asyncio.sleep(0.05)
+                await self._relay_flush(st)
+                if not st.relay_offers or self.round is not st:
+                    return
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._trace("overlay_relay_error",
+                        error=f"{type(e).__name__}: {e}")
+
+    async def _relay_flush(self, st: RoundState) -> None:
+        """Interior-node combine: sum the buffered leaves' share rows,
+        blind rows (mod q) and Pedersen commitment grids (point-wise —
+        additively homomorphic), then ship ONE RegisterAggregate per
+        miner. A miner that refuses the aggregate (RLC failure, member
+        conflict) gets the buffered per-member frames instead — the
+        exact per-update path, so rejection evidence is unchanged."""
+        offers, st.relay_offers = st.relay_offers, {}
+        if not offers or self.round is not st:
+            return
+        members = sorted(offers)
+        st.relay_flushed |= set(members)
+        cfg = self.cfg
+        _, miners, _, _ = self.role_map.committee()
+        miners = sorted(miners)
+
+        def build():
+            grids = cm.sum_commitment_grids(
+                [offers[n]["comms"] for n in members])
+            blinds = cm.sum_blind_row_tensors(
+                [offers[n]["blinds"] for n in members])
+            rows = np.asarray(ss.aggregate_shares(
+                np.stack([offers[n]["shares"] for n in members])))
+            return grids, blinds, rows
+
+        with self.tele.span("overlay_aggregate", it=st.iteration):
+            comms_sum, blinds_sum, rows_sum = await asyncio.to_thread(build)
+        member_meta = [{"source_id": n,
+                        "commitment": offers[n]["commitment"],
+                        "signers": offers[n]["signers"],
+                        "signatures": offers[n]["signatures"]}
+                       for n in members]
+        for idx, m in enumerate(miners):
+            sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
+            ok = False
+            if comms_sum is not None and len(members) >= 2:
+                try:
+                    await self._call(m, "RegisterAggregate", {
+                        "iteration": st.iteration, "source_id": self.id,
+                        "miner_index": idx, "members": member_meta,
+                    }, {"agg_rows": rows_sum[sl],
+                        "agg_blinds": blinds_sum[sl],
+                        "agg_comms": comms_sum})
+                    ok = True
+                except Exception as e:
+                    self._trace("overlay_aggregate_refused", miner=m,
+                                error=type(e).__name__)
+            if ok:
+                self._trace("overlay_aggregate_sent", miner=m,
+                            n=len(members))
+                self._overlay_saved(
+                    len(members) - 1,
+                    member_meta[0],
+                    {"share_rows": rows_sum[sl],
+                     "blind_rows": blinds_sum[sl],
+                     "comms": comms_sum})
+                continue
+            # fallback: forward the buffered per-member frames — bit-
+            # equivalent to the workers' own direct sends, so the miner's
+            # per-update verification (and its bisection evidence on a
+            # corrupted member) applies unchanged
+            for n in members:
+                o = offers[n]
+                self._trace("overlay_fallback_forwarded", miner=m, source=n)
+                await self._safe_call(m, "RegisterSecret", {
+                    "iteration": st.iteration, "source_id": n,
+                    "miner_index": idx, "commitment": o["commitment"],
+                    "signers": o["signers"], "signatures": o["signatures"],
+                }, {"share_rows": o["shares"][sl],
+                    "blind_rows": o["blinds"][sl], "comms": o["comms"]})
+
+    async def _h_register_aggregate(self, meta, arrays):
+        """Miner intake of one subtree aggregate: per-member signature
+        quorums are checked INDIVIDUALLY (unaggregated, so defense
+        verdicts and stake accounting are unchanged), then the whole
+        subtree settles in ONE share-vs-commitment RLC check against
+        the homomorphically summed grid — W verifications collapse to
+        one per subtree. Refusals are ordinary RPCErrors: the relay
+        falls back to per-member delivery and the exact per-update
+        machinery assigns blame.
+
+        The summed grid is relay-supplied: the per-member digest binding
+        is enforced at the RELAY (which holds the per-member grids), not
+        here — the documented overlay residual (runtime/overlay.py
+        KNOWN RESIDUAL, docs/OVERLAY.md §trust-model): a Byzantine relay
+        can substitute its own subtree's aggregate, which in the
+        deployed intra-hive shape adds nothing to what the members' own
+        host could already do."""
+        it = int(meta["iteration"])
+        if it < self.iteration:
+            raise StaleError()
+        st = await self._wait_round_ready(it)
+        if not self.role_map.is_miner(self.id):
+            raise RPCError("not a miner this round")
+        if not (self.overlay.enabled and self.cfg.secure_agg):
+            raise RPCError("overlay aggregation disabled")
+        mm = meta.get("members") or []
+        try:
+            members = [int(x["source_id"]) for x in mm]
+        except (TypeError, KeyError, ValueError):
+            raise RPCError("malformed member metadata")
+        if not members or len(set(members)) != len(members):
+            raise RPCError("bad member list")
+        if any(n not in self.peers for n in members):
+            raise RPCError("unknown member")
+        conflicts = sorted(n for n in members
+                           if n in st.miner_shares
+                           or n in st.miner_group_of
+                           or n in st.miner_rejected)
+        if conflicts:
+            raise RPCError(f"members already registered: {conflicts}")
+        cfg = self.cfg
+        c = ss.num_chunks(self.trainer.num_params, cfg.poly_size)
+        rows = np.asarray(arrays.get("agg_rows", np.zeros(0)), np.int64)
+        blinds = np.asarray(arrays.get("agg_blinds", np.zeros(0)), np.uint8)
+        comms = np.asarray(arrays.get("agg_comms", np.zeros(0)), np.uint8)
+        if rows.shape != (cfg.shares_per_miner, c) \
+                or blinds.shape != (cfg.shares_per_miner, c, 32) \
+                or comms.shape != (c, cfg.poly_size, 64):
+            raise RPCError("bad aggregate tensor shapes")
+        if cfg.verification:
+            # all member quorums in ONE thread hop: a 50-leaf subtree
+            # must not serialize 50 to_thread round-trips on the
+            # round-critical intake path (each check is itself a batched
+            # RLC Schnorr verify inside _verify_sig_quorum)
+            def check_quorums() -> str:
+                for x in mm:
+                    commitment = bytes.fromhex(str(x.get("commitment", "")))
+                    ok, why = self._check_secret_quorum(
+                        commitment,
+                        {"iteration": it, "source_id": x["source_id"],
+                         "signers": x.get("signers", []),
+                         "signatures": x.get("signatures", [])})
+                    if not ok:
+                        return f"member {x['source_id']}: {why}"
+                return ""
+            with self.tele.span("sig_check", it=it):
+                bad = await asyncio.to_thread(check_quorums)
+            if bad:
+                raise RPCError(bad)
+        xs = st.my_xs
+        if xs is None:
+            raise RPCError("share layout not armed")
+        t0_mv = time.monotonic()
+        with self.tele.span("miner_verify", it=it):
+            ok = await asyncio.to_thread(
+                cm.vss_verify_multi, [(comms, xs, rows, blinds)])
+        await self._slow_pad(time.monotonic() - t0_mv)
+        if not ok:
+            self._trace("overlay_aggregate_rejected", n=len(members))
+            raise RPCError("aggregate fails the RLC consistency check")
+        g = frozenset(members)
+        st.miner_groups[g] = {"rows": rows, "comms": comms,
+                              "blinds": blinds}
+        for x in mm:
+            n = int(x["source_id"])
+            st.miner_group_of[n] = g
+            st.miner_commitments[n] = bytes.fromhex(
+                str(x.get("commitment", "")))
+            try:
+                st.miner_sigs[n] = (
+                    [int(s) for s in x.get("signers", [])],
+                    [bytes.fromhex(s) for s in x.get("signatures", [])])
+            except (ValueError, TypeError):
+                pass
+            st.miner_vss_batch[n] = g
+        self._trace("overlay_aggregate_registered", n=len(members),
+                    have=len(self._sec_sources(st)))
+        self.tele.registry.counter(ov.FRAMES_METRIC, ov.FRAMES_HELP).inc(
+            kind="aggregated")
+        return {}, {}
+
+    async def _relay_send(self, relay: int, inner_type: str, meta, arrays,
+                          ts: List[int], it: int,
+                          timeout: Optional[float] = None) -> None:
+        """One deduplicated fan-out leg: ship the frame to `relay` for
+        forwarding to `ts`. On ANY failure the orphaned targets get the
+        seed path's direct sends — the missing-interior-node
+        degradation, shared by the update and block broadcast paths."""
+        try:
+            await self._call(relay, "RelayFrames", {
+                "iteration": it, "source_id": self.id,
+                "inner_type": inner_type, "inner_meta": meta,
+                "targets": ts,
+            }, arrays, timeout=timeout)
+        except Exception as e:
+            self._trace("overlay_relay_fallback", relay=relay,
+                        error=type(e).__name__)
+            await asyncio.gather(*(
+                self._safe_call(t, inner_type, meta, arrays) for t in ts))
+            return
+        self._trace("overlay_relayed_sent", relay=relay, targets=len(ts))
+        self._overlay_saved(len(ts) - 1, meta, arrays)
+        self.tele.registry.counter(ov.FRAMES_METRIC,
+                                   ov.FRAMES_HELP).inc(kind="relayed")
+
+    async def _overlay_fanout(self, msg_type: str, meta, arrays,
+                              targets: List[int], it: int) -> None:
+        """Overlay-aware push fan-out for verbatim frames: targets that
+        share a remote subtree receive the frame through that subtree's
+        relay (one TCP crossing per subtree); everything else — and any
+        subtree whose relay fails — goes direct, the seed path."""
+        direct, relayed = self.overlay.plan(targets, it, self.id)
+        await asyncio.gather(
+            *(self._safe_call(t, msg_type, meta, arrays) for t in direct),
+            *(self._relay_send(r, msg_type, meta, arrays, ts, it)
+              for r, ts in relayed.items()))
+
+    async def _h_relay_frames(self, meta, arrays):
+        """Interior-node forwarding of a verbatim frame to leaves of OUR
+        subtree. The inner type is whitelisted to the two push frames
+        the overlay deduplicates; every receiver re-validates the
+        forwarded content exactly as it would a direct send, so a
+        Byzantine relay can at worst drop (the round's existing
+        degradation), never forge. Forwarding is scheduled and the ACK
+        returned immediately — custody semantics match a fire-and-
+        forget post."""
+        if not self.overlay.enabled:
+            raise RPCError("overlay disabled")
+        inner_type = str(meta.get("inner_type", ""))
+        if inner_type not in ("RegisterUpdate", "RegisterBlock"):
+            raise RPCError("inner type not relayable")
+        inner_meta = meta.get("inner_meta")
+        if not isinstance(inner_meta, dict):
+            raise RPCError("malformed inner meta")
+        try:
+            targets = [int(x) for x in meta.get("targets", [])]
+        except (TypeError, ValueError):
+            raise RPCError("malformed target list")
+        grp = set(self.overlay.members(self.overlay.gid_of(self.id)))
+        if not targets or len(set(targets)) != len(targets) \
+                or any(t not in grp for t in targets):
+            raise RPCError("targets outside this relay's subtree")
+
+        async def forward(t: int):
+            try:
+                if t == self.id:
+                    await self._handle(inner_type, dict(inner_meta),
+                                       arrays)
+                else:
+                    await self._call(t, inner_type, dict(inner_meta),
+                                     arrays)
+                self._trace("overlay_relay_forwarded", target=t,
+                            inner=inner_type)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # receiver-side verdicts are the receiver's business
+
+        loop = asyncio.get_running_loop()
+        for t in targets:
+            task = loop.create_task(forward(t))
+            self._bg_tasks.add(task)
+            task.add_done_callback(self._bg_tasks.discard)
+        return {"targets": len(targets)}, {}
+
     # ---------------------------------------------------------------- miner
 
     def _miner_leader(self, miners: List[int]) -> int:
@@ -3097,13 +3695,14 @@ class PeerAgent:
         accounted_set: Set[int] = set()
         try:
             while time.monotonic() - t0 < deadline:
-                have_map = st.miner_shares if sec else st.miner_updates
-                have = len(have_map)
+                have_keys = (self._sec_sources(st) if sec
+                             else set(st.miner_updates))
+                have = len(have_keys)
                 # every expected contributor has responded — a submission, a
                 # provably bad one, or a signed decline (verifier-refused
                 # workers, RegisterDecline): mint at once. Union-counted so a
                 # Byzantine worker both declining and submitting is one peer.
-                accounted_set = (have_map.keys() | st.miner_rejected.keys()
+                accounted_set = (have_keys | st.miner_rejected.keys()
                                  | st.miner_declined)
                 accounted = len(accounted_set)
                 # stall forensics: while blocked, publish exactly who this
@@ -3148,7 +3747,8 @@ class PeerAgent:
         # are) and never breaker evidence — the ISSUE's
         # honest-straggler-never-quarantined contract.
         shortfall = cfg.num_samples - len(accounted_set)
-        if shortfall > 0 and (st.miner_shares if sec else st.miner_updates):
+        if shortfall > 0 and (self._sec_sources(st) if sec
+                              else st.miner_updates):
             missing = sorted(n for n in expected
                              if n not in accounted_set and n != self.id)
             self.straggler.exclude(phase, missing[:shortfall])
@@ -3185,7 +3785,7 @@ class PeerAgent:
             # recover from the survivors' re-dealt shares — the seed
             # behavior (a lost miner empties the intersection and the
             # round) remains when resharing is off.
-            node_sets = [set(self.round.miner_shares)]
+            node_sets = [self._sec_sources(st)]
             reachable = [self.id]
             for m in miners:
                 if m == self.id:
@@ -3216,7 +3816,7 @@ class PeerAgent:
             # removes at least one sid from miner_shares.
             while nodes and not await self._ensure_subset_consistent(
                     st, nodes):
-                nodes = [n for n in nodes if n in st.miner_shares]
+                nodes = [n for n in nodes if n in self._sec_sources(st)]
             rejected_ids = set(st.miner_rejected)
             agg = np.zeros(self.trainer.num_params, np.float64)
             if nodes and lost and self.cfg.reshare:
@@ -3235,9 +3835,11 @@ class PeerAgent:
                 ok = True
                 for idx, m in enumerate(miners):
                     if m == self.id:
-                        stack = np.stack([self.round.miner_shares[n]
-                                          for n in nodes])
-                        slices[idx] = np.asarray(ss.aggregate_shares(stack))
+                        decomp = self._sec_decompose(st, nodes)
+                        if decomp is None:
+                            return self._empty_block()
+                        slices[idx] = np.asarray(ss.aggregate_shares(
+                            np.stack(decomp[0])))
                         continue
                     try:
                         _, arrs = await self._call(m, "GetMinerPart", {

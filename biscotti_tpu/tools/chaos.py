@@ -85,7 +85,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="seeded chaos cluster run")
     ap.add_argument("--nodes", type=int, default=4)
     ap.add_argument("--rounds", type=int, default=3)
-    ap.add_argument("--base-port", type=int, default=26100)
+    ap.add_argument("--base-port", type=int, default=13900)
     ap.add_argument("--dataset", default="creditcard")
     ap.add_argument("--secure-agg", type=int, default=0)
     ap.add_argument("--verification", type=int, default=0)
@@ -157,6 +157,14 @@ def main(argv=None) -> int:
                     help="1 arms the straggler-tolerance plane on every "
                          "peer: adaptive per-phase round deadlines + "
                          "partial-quorum graceful degradation")
+    ap.add_argument("--overlay", type=int, default=0,
+                    help="1 arms the hierarchical aggregation overlay on "
+                         "every peer — including the flooding peer, so "
+                         "overlay+flood+churn+slow compose in one seeded "
+                         "replayable run (docs/OVERLAY.md)")
+    ap.add_argument("--overlay-group", type=int, default=0,
+                    help="peers per overlay subtree (default: nodes//2, "
+                         "so a chaos cluster always has >= 2 subtrees)")
     ns = ap.parse_args(argv)
     if ns.flood and not (0 <= ns.flood_node < ns.nodes):
         ap.error(f"--flood-node {ns.flood_node} outside 0..{ns.nodes - 1}")
@@ -211,6 +219,10 @@ def main(argv=None) -> int:
     fast = Timeouts(update_s=4.0, block_s=12.0, krum_s=3.0, share_s=4.0,
                     rpc_s=4.0)
 
+    overlay_group = 0
+    if ns.overlay:
+        overlay_group = ns.overlay_group or max(2, ns.nodes // 2)
+
     def cfg(i):
         flooding = ns.flood > 0 and i == ns.flood_node
         return BiscottiConfig(
@@ -227,6 +239,10 @@ def main(argv=None) -> int:
             admission_plan=admission,
             snapshot_bootstrap=bool(ns.snapshot_bootstrap),
             adaptive_deadlines=bool(ns.adaptive_deadlines),
+            # carried on EVERY peer's config — the `plan` peers and the
+            # flood_plan flooder alike — so an overlay chaos run stays
+            # one-seed replayable across all composed planes
+            overlay=bool(ns.overlay), overlay_group=overlay_group,
             wire_codec=ns.codec)
 
     if ns.churn > 0:
@@ -277,6 +293,13 @@ def main(argv=None) -> int:
                 if (ns.slow > 0 or ns.slow_node >= 0) else None,
         "adaptive_deadlines": bool(ns.adaptive_deadlines),
         "admission_enabled": admit,
+        # aggregation-overlay readout (docs/OVERLAY.md): the armed knobs
+        # plus the cluster's aggregated/direct/fallback tallies
+        # (obs.merge_overlay — one definition with a live scrape)
+        "overlay": {"enabled": bool(ns.overlay),
+                    "group": overlay_group,
+                    **cluster["overlay"]} if ns.overlay
+                   else cluster["overlay"],
         # straggler readout (docs/STRAGGLERS.md): cluster excluded/stall
         # tallies + slowest-peer table (obs.merge_stragglers — one
         # definition with a live scrape) and each peer's bounded

@@ -87,9 +87,11 @@ def merge_wire(snaps: List[Dict]) -> Dict:
     path AVOIDED; without it a fully co-hosted cluster reads "out 0B"
     and the layout comparison the accounting exists for goes dark."""
     out = {"out_bytes": 0, "in_bytes": 0, "loopback_bytes": 0,
+           "cross_host_bytes": 0, "overlay_saved_bytes": 0,
            "out_by_codec": {}, "out_by_msg_type": {}}
     for snap in snaps:
-        fam = (snap.get("metrics") or {}).get("biscotti_wire_bytes_total")
+        metrics = snap.get("metrics") or {}
+        fam = metrics.get("biscotti_wire_bytes_total")
         for row in (fam or {}).get("series", []):
             labels = row.get("labels", {})
             v = int(row.get("value", 0))
@@ -105,6 +107,17 @@ def merge_wire(snaps: List[Dict]) -> Dict:
                 out["in_bytes"] += v
             elif labels.get("direction") == "loopback":
                 out["loopback_bytes"] += v
+        saved = metrics.get("biscotti_overlay_bytes_saved_total")
+        for row in (saved or {}).get("series", []):
+            out["overlay_saved_bytes"] += int(row.get("value", 0))
+    # first-class split (docs/OVERLAY.md §accounting): `cross_host_bytes`
+    # is outbound traffic that actually left the process over TCP —
+    # direction="out" by construction (loopback frames carry their own
+    # direction) — vs `loopback_bytes`, the co-hosted traffic the hive
+    # fast path AVOIDED. The O(N)->O(log N) headline reads straight off
+    # this pair; `overlay_saved_bytes` is the overlay's own estimate of
+    # the deduplicated/aggregated frames it kept off TCP.
+    out["cross_host_bytes"] = out["out_bytes"]
     return out
 
 
@@ -227,6 +240,27 @@ def _fmt_bytes(n: float) -> str:
     return f"{n:.1f}GB"
 
 
+def merge_overlay(snaps: List[Dict]) -> Dict:
+    """Merge every peer's aggregation-overlay readout (docs/OVERLAY.md)
+    into one cluster table: armed-peer count, tree shape, and the
+    aggregated / relayed / fallback frame tallies the acceptance runs
+    and the chaos report's `overlay` key assert on."""
+    out: Dict = {"enabled_peers": 0, "group_size": 0, "depth": 1,
+                 "aggregated": 0, "aggregates_sent": 0, "offers": 0,
+                 "relayed": 0, "forwarded": 0, "direct": 0, "fallback": 0}
+    for snap in snaps:
+        o = snap.get("overlay") or {}
+        if o.get("enabled"):
+            out["enabled_peers"] += 1
+            out["group_size"] = max(out["group_size"],
+                                    int(o.get("group_size", 0)))
+            out["depth"] = max(out["depth"], int(o.get("depth", 1)))
+        for k in ("aggregated", "aggregates_sent", "offers", "relayed",
+                  "forwarded", "direct", "fallback"):
+            out[k] += int(o.get(k, 0))
+    return out
+
+
 def merge_snapshots(snaps: List[Dict]) -> Dict:
     """One cluster table from per-peer telemetry snapshots (the schema
     `PeerAgent.telemetry_snapshot()` / the `Metrics` RPC serve)."""
@@ -277,6 +311,13 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
     # bytes/round: cluster outbound traffic amortized over settled
     # rounds — THE comms-cost number the wire plane exists to shrink
     wire["bytes_per_round"] = round(wire["out_bytes"] / max(1, max(hs)), 1)
+    # the overlay headline pair, first-class: TCP-crossing bytes per
+    # round vs the loopback traffic the hive fast path avoided — read
+    # straight off the artifact instead of hand-derived (docs/OVERLAY.md)
+    wire["cross_host_bytes_per_round"] = round(
+        wire["cross_host_bytes"] / max(1, max(hs)), 1)
+    wire["loopback_avoided_bytes_per_round"] = round(
+        wire["loopback_bytes"] / max(1, max(hs)), 1)
     return {
         "nodes": len(snaps),
         "round_height": {"min": min(hs), "max": max(hs),
@@ -291,6 +332,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "faults": faults,
         "counters": counters,
         "wire": wire,
+        "overlay": merge_overlay(snaps),
         "admission": merge_admission(snaps),
         "stragglers": merge_stragglers(snaps),
         "hives": merge_hives(snaps),
@@ -349,6 +391,20 @@ def format_table(merged: Dict) -> str:
                       + (f"   loopback {_fmt_bytes(lb)} avoided"
                          if lb else "")
                       + (f"   [{by_codec}]" if by_codec else "")]
+        xh = _fmt_bytes(wire.get("cross_host_bytes", 0))
+        xh_r = _fmt_bytes(wire.get("cross_host_bytes_per_round", 0))
+        lb_r = _fmt_bytes(wire.get("loopback_avoided_bytes_per_round", 0))
+        lines += [f"wire: cross-host {xh} ({xh_r}/round)   "
+                  f"loopback-avoided {lb_r}/round"]
+    olay = merged.get("overlay") or {}
+    if olay.get("enabled_peers"):
+        lines += ["", f"overlay: {olay['enabled_peers']} peers armed  "
+                      f"depth {olay['depth']}  group {olay['group_size']}  "
+                      f"aggregated {olay['aggregated']}  "
+                      f"relayed {olay['relayed']}  "
+                      f"forwarded {olay['forwarded']}  "
+                      f"fallback {olay['fallback']}  "
+                      f"direct {olay['direct']}"]
     adm = merged.get("admission") or {}
     if adm.get("enabled_peers") or adm.get("shed_total"):
         by_reason = ", ".join(f"{k}:{v}" for k, v in

@@ -80,6 +80,10 @@ def hive_cmd(args, start, count, total, peers_file, hive_id,
            "--seed", str(args.seed),
            "--local", f"{start}:{count}",
            "--hive-id", hive_id]
+    if getattr(args, "overlay", 0):
+        # the aggregation subtree = this launcher's per-host span, so
+        # the tree's interior level IS the hive host (docs/OVERLAY.md)
+        cmd += ["--overlay", "1", "--overlay-group", str(count)]
     if args.key_dir:
         cmd += ["--key-dir", args.key_dir]
     return cmd
@@ -125,6 +129,9 @@ def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
            "-nn", str(committee_size(args.num_noisers, total)),
            "--max-iterations", str(args.iterations),
            "--seed", str(args.seed)]
+    if getattr(args, "overlay", 0):
+        per = args.peers_per_host or args.nodes_per_host
+        cmd += ["--overlay", "1", "--overlay-group", str(per)]
     if args.key_dir:
         cmd += ["--key-dir", args.key_dir]
     return cmd
@@ -142,12 +149,16 @@ def main(argv=None) -> int:
                          "of nodes-per-host full agent processes — the "
                          "single-box scale wall breaker (docs/HIVE.md)")
     ap.add_argument("--dataset", default="mnist")
-    ap.add_argument("--base-port", type=int, default=23500)
+    ap.add_argument("--base-port", type=int, default=14350)
     ap.add_argument("--iterations", type=int, default=5)
     ap.add_argument("--secure-agg", type=int, default=0)
     ap.add_argument("--noising", type=int, default=0)
     ap.add_argument("--verification", type=int, default=1)
     ap.add_argument("--key-dir", default="")
+    ap.add_argument("--overlay", type=int, default=0,
+                    help="1 arms the hierarchical aggregation overlay on "
+                         "every launched peer/hive, with the subtree "
+                         "sized to the per-host span (docs/OVERLAY.md)")
     ap.add_argument("--num-miners", type=int, default=3)
     ap.add_argument("--num-verifiers", type=int, default=3)
     ap.add_argument("--num-noisers", type=int, default=2)
@@ -256,10 +267,18 @@ def main(argv=None) -> int:
         summary = {
             "total_nodes": total, "hosts": len(hosts),
             "hive_mode": True, "peers_per_host": per_host,
+            "overlay": bool(args.overlay),
             "chains_equal": equal,
             "blocks": ok[0].get("blocks", 0) if ok else 0,
             "s_per_iter": max((s.get("s_per_iter", 0.0) for s in ok),
                               default=None),
+            # fleet-wide TCP-crossing bytes per round (summed over
+            # hives): THE overlay headline, read off the artifact
+            "cross_host_bytes_per_round": round(sum(
+                s.get("cross_host_bytes_per_round", 0) for s in ok), 1),
+            "loopback_avoided_bytes_per_round": round(sum(
+                s.get("loopback_avoided_bytes_per_round", 0)
+                for s in ok), 1),
             "rss_per_peer_bytes": max(
                 (s.get("rss_per_peer_bytes", 0) for s in ok),
                 default=None),

@@ -250,7 +250,7 @@ def test_flood_fault_kind_deterministic_and_enabled():
 
 
 def test_call_retries_busy_with_backoff_breaker_never_advances():
-    agent = PeerAgent(_cfg(0, 2, 25600))
+    agent = PeerAgent(_cfg(0, 2, 15640))
     attempts = []
 
     async def busy_then_ok(host, port, msg_type, meta, arrays, timeout,
@@ -273,7 +273,7 @@ def test_call_retries_busy_with_backoff_breaker_never_advances():
 
 
 def test_permanently_busy_peer_gives_up_without_quarantine():
-    agent = PeerAgent(_cfg(0, 2, 25600))
+    agent = PeerAgent(_cfg(0, 2, 15640))
     calls = []
 
     async def always_busy(host, port, msg_type, meta, arrays, timeout,
@@ -297,7 +297,7 @@ def test_permanently_busy_peer_gives_up_without_quarantine():
 def test_gossip_fanout_deprioritizes_busy_peer():
     # 10 peers: fan-out = max(3, log2(9)+1) = 4, fresh targets (8) fill
     # the draw, so the busy peer must not be advertised to this round
-    agent = PeerAgent(_cfg(0, 10, 25600))
+    agent = PeerAgent(_cfg(0, 10, 15640))
     busy_pid = 3
     agent._busy_peers[busy_pid] = agent.iteration
     sent = []
@@ -321,7 +321,7 @@ def test_gossip_fanout_deprioritizes_busy_peer():
     assert agent.health.state(busy_pid) == faults.CLOSED
     # when fresh targets CANNOT fill the draw, busy peers top it up —
     # coverage beats politeness
-    agent2 = PeerAgent(_cfg(0, 4, 25600))
+    agent2 = PeerAgent(_cfg(0, 4, 15640))
     for pid in (1, 2, 3):
         agent2._busy_peers[pid] = agent2.iteration
     sent2 = []
@@ -342,7 +342,7 @@ def test_gossip_fanout_deprioritizes_busy_peer():
 
 
 def test_wait_for_iteration_sheds_oldest_as_busy():
-    agent = PeerAgent(_cfg(0, 2, 25600,
+    agent = PeerAgent(_cfg(0, 2, 15640,
                            admission_plan=AdmissionPlan(enabled=True,
                                                         max_parked=1)))
 
@@ -371,7 +371,7 @@ def test_wait_for_iteration_sheds_oldest_as_busy():
 
 
 def test_server_sheds_over_inflight_cap_with_busy_status():
-    port = 25660
+    port = 15660
 
     async def go():
         gate = asyncio.Event()
@@ -409,7 +409,7 @@ def test_server_sheds_over_inflight_cap_with_busy_status():
 
 
 def test_read_deadline_drops_slow_loris_but_not_honest_conns():
-    port = 25670
+    port = 15670
 
     async def go():
         async def handler(mt, meta, arrays):
@@ -447,7 +447,7 @@ def test_read_deadline_chunk_progress_keeps_slow_bulk_transfers_alive():
 
     from biscotti_tpu.runtime import messages as msgs
 
-    port = 25690
+    port = 15690
 
     async def go():
         got = []
@@ -488,7 +488,7 @@ def test_read_deadline_chunk_progress_keeps_slow_bulk_transfers_alive():
 
 
 def test_read_deadline_zero_keeps_legacy_patience():
-    port = 25680
+    port = 15680
 
     async def go():
         async def handler(mt, meta, arrays):
@@ -533,7 +533,7 @@ def test_flood_cluster_sheds_and_completes_with_equal_chains():
     training with the settled-chain oracle passing, nonzero sheds on the
     honest peers, inflight/parked peaks bounded by the caps, and no
     breaker opened by the overload (BusyError never feeds it)."""
-    n, port, flood_node = 4, 25700, 1
+    n, port, flood_node = 4, 15700, 1
 
     async def go():
         agents = [PeerAgent(c) for c in _flood_cluster_cfgs(
@@ -573,7 +573,7 @@ def test_admission_without_flood_sheds_nothing():
     """The governance plane must be invisible to an honest cluster: the
     same admission plan with no flooder records ZERO sheds and the run
     completes identically."""
-    n, port = 4, 25720
+    n, port = 4, 15720
 
     async def go():
         agents = [PeerAgent(c) for c in _flood_cluster_cfgs(
@@ -614,7 +614,7 @@ def test_flood_acceptance_mnist_cluster():
         return asyncio.run(go())
 
     # 1. flood + admission: survives, sheds, bounded
-    res_flood = run(25740, 50, TIGHT)
+    res_flood = run(15740, 50, TIGHT)
     equal, common, real_blocks = chaos.chain_oracle(res_flood)
     assert equal and common >= 2 and real_blocks >= 1
     snaps = [r["telemetry"] for r in res_flood]
@@ -634,14 +634,14 @@ def test_flood_acceptance_mnist_cluster():
             if int(pid) != flood_node:
                 assert h.get("opens", 0) == 0, (s["node"], pid, h)
     # 2. admission, no flood: zero sheds, no breaker opens at all
-    res_clean = run(25760, 0, TIGHT)
+    res_clean = run(15760, 0, TIGHT)
     equal, _, real_blocks = chaos.chain_oracle(res_clean)
     assert equal and real_blocks >= 1
     for r in res_clean:
         assert r["telemetry"]["admission"]["shed_total"] == 0
         assert r["telemetry"]["counters"].get("breaker_open", 0) == 0
     # 3. no-admission baseline: final error within noise
-    res_base = run(25780, 0, AdmissionPlan())
+    res_base = run(15780, 0, AdmissionPlan())
     equal, _, real_blocks = chaos.chain_oracle(res_base)
     assert equal and real_blocks >= 1
     err_clean = res_clean[0]["final_error"]

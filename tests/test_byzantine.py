@@ -124,7 +124,7 @@ class BadCommitPeer(PeerAgent):
 
 
 def test_corrupt_shares_detected_and_debited():
-    n, port = 5, 25010
+    n, port = 5, 15010
     byz = _round0_vanilla(n)
     # defense=NONE so the update passes the verifier committee — the
     # corruption must be caught by the MINER's VSS share check, not Krum
@@ -176,7 +176,7 @@ def test_colluding_cancellation_caught_at_aggregation_boundary():
     the leader would serve/mint an aggregate shifted by e; with it, the
     partial-batch re-proof isolates B, debits it with leader evidence,
     and the block carries only honest updates."""
-    n, port = 7, 25070
+    n, port = 7, 15070
     chain = Blockchain(50, n, 10)
     verifiers, miners = R.elect_committees(
         chain.latest_stake_map(), chain.latest_hash(), 1, 2, n)
@@ -229,7 +229,7 @@ def test_colluding_cancellation_caught_at_aggregation_boundary():
 
 
 def test_forged_commitment_detected_and_debited():
-    n, port = 5, 25020
+    n, port = 5, 15020
     byz = _round0_vanilla(n)
     cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
                  defense=Defense.NONE, max_iterations=1) for i in range(n)]
@@ -238,7 +238,7 @@ def test_forged_commitment_detected_and_debited():
 
 
 def test_fake_noiser_lottery_refused():
-    n, port = 5, 25030
+    n, port = 5, 15030
     byz = _round0_vanilla(n)
     cfgs = [_cfg(i, n, port, noising=True, max_iterations=1)
             for i in range(n)]
@@ -253,7 +253,7 @@ def test_fake_noiser_lottery_refused():
 
 
 def test_plain_mode_bad_commitment_detected_and_debited():
-    n, port = 5, 25040
+    n, port = 5, 15040
     byz = _round0_vanilla(n)
     cfgs = [_cfg(i, n, port, max_iterations=1) for i in range(n)]
     results, agents = _run_mixed_cluster(cfgs, byz, BadCommitPeer)
@@ -269,7 +269,7 @@ def test_high_degree_commitment_rejected():
     from biscotti_tpu.crypto import commitments as cm
     from biscotti_tpu.ops import secretshare as ss
 
-    cfg = _cfg(0, 3, 25060, secure_agg=True)
+    cfg = _cfg(0, 3, 15060, secure_agg=True)
     agent = PeerAgent(cfg)
     agent.role_map = R.RoleMap.build(3, verifiers=[1], miners=[0])
     c = ss.num_chunks(agent.trainer.num_params, cfg.poly_size)
@@ -291,7 +291,7 @@ def test_signature_replay_across_rounds_fails():
 
     from biscotti_tpu.crypto import commitments as cm
 
-    cfg = _cfg(0, 3, 25070)
+    cfg = _cfg(0, 3, 15070)
     agent = PeerAgent(cfg)
     agent.role_map = R.RoleMap.build(3, verifiers=[1], miners=[0])
     v_seed = hashlib.sha256(f"schnorr-{cfg.seed}-1".encode()).digest()
@@ -309,7 +309,7 @@ def test_forged_heavy_chain_refused_without_quorums():
     # it is structurally valid and heavier than ours
     from biscotti_tpu.ledger.block import Block, BlockData, Update
 
-    cfg = _cfg(0, 4, 25080, verification=True)
+    cfg = _cfg(0, 4, 15080, verification=True)
     agent = PeerAgent(cfg)
     blocks = [agent.chain.blocks[0]]
     for i in range(3):
@@ -344,7 +344,7 @@ def test_share_release_requires_leader_signature():
 
     from biscotti_tpu.runtime.rpc import RPCError
 
-    cfg = _cfg(0, 4, 25090, secure_agg=True, verification=True)
+    cfg = _cfg(0, 4, 15090, secure_agg=True, verification=True)
     agent = PeerAgent(cfg)
     agent.role_map = R.RoleMap.build(4, verifiers=[1], miners=[agent.id, 3])
 
@@ -383,7 +383,7 @@ def test_share_release_requires_leader_signature():
 def test_honest_secureagg_cluster_still_accepts_everyone():
     # control: with no Byzantine peer the enforcement path accepts all
     # submissions and nobody is debited
-    n, port = 5, 25050
+    n, port = 5, 15050
     cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
                  noising=True, defense=Defense.KRUM, max_iterations=2)
             for i in range(n)]
@@ -407,7 +407,7 @@ def test_reduced_redundancy_closes_differencing_and_still_converges():
     # share_redundancy < 2 forces any recovering miner subset past M/2, so
     # two disjoint subsets cannot both reconstruct and the per-miner
     # one-set guard covers every pair; the protocol must still converge
-    n, port = 6, 25100
+    n, port = 6, 15100
     cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
                  num_miners=3, defense=Defense.NONE, max_iterations=1,
                  share_redundancy=1.5) for i in range(n)]
@@ -442,7 +442,7 @@ def test_quorum_memo_cannot_be_poisoned_by_relabeled_block():
     from biscotti_tpu.crypto import commitments as cm
     from biscotti_tpu.ledger.block import Block, BlockData, Update
 
-    cfg = _cfg(0, 4, 25100, verification=True)
+    cfg = _cfg(0, 4, 15100, verification=True)
     agent = PeerAgent(cfg)
     genesis = agent.chain.blocks[0]
     vset = agent._committee_for(genesis.stake_map, genesis.hash)

@@ -106,7 +106,7 @@ def test_corrupt_newest_falls_back_to_older_snapshot(tmp_path):
                     rpc_s=3.0)
     cfg = BiscottiConfig(dataset="creditcard", num_nodes=3, node_id=0,
                          max_iterations=2, secure_agg=False, noising=False,
-                         verification=False, fedsys=True, base_port=24980,
+                         verification=False, fedsys=True, base_port=14260,
                          timeouts=fast)
     cdir = tmp_path / "node_0"
     agent = PeerAgent(cfg, ckpt_dir=str(cdir), ckpt_every=100)
@@ -166,7 +166,7 @@ def test_peer_survives_corrupt_checkpoint(tmp_path):
                     rpc_s=3.0)
     cfg = BiscottiConfig(dataset="creditcard", num_nodes=1, node_id=0,
                          max_iterations=1, secure_agg=False, noising=False,
-                         verification=False, fedsys=True, base_port=24990,
+                         verification=False, fedsys=True, base_port=14270,
                          timeouts=fast)
     agent = PeerAgent(cfg, ckpt_dir=str(cdir))
     result = asyncio.run(agent.run())

@@ -391,3 +391,113 @@ def test_verify_multi_zero_width_grid_rejects_not_raises():
     rows = np.zeros((2, 4), dtype=np.int64)
     br = np.zeros((2, 4, 32), dtype=np.uint8)
     assert cmx.vss_verify_multi([(comms, [1, 2], rows, br)]) is False
+
+
+# ----------------------------------------------------------------------
+# Pedersen homomorphic summation under arbitrary tree shapes — the
+# algebra the hierarchical aggregation overlay stands on
+# (runtime/overlay.py, docs/OVERLAY.md): interior nodes may sum worker
+# grids/blinds/shares in ANY association order and the root's one
+# aggregated verification must equal flat per-worker verification.
+
+
+def _overlay_instance(tag: int, d: int = 8, k: int = 4, total: int = 6):
+    """One worker-style VSS instance built exactly the way the peer
+    runtime builds it: quantized vector -> chunk commitments + packed
+    blinds -> share matrix + blind-row tensor over all share points."""
+    rng = np.random.default_rng(1000 + tag)
+    q = rng.integers(-50_000, 50_000, size=d).astype(np.int64)
+    c = ss.num_chunks(d, k)
+    padded = np.zeros(c * k, np.int64)
+    padded[:d] = q
+    comms, blind_bytes = cm.vss_commit_chunks_bytes(
+        padded.reshape(c, k), bytes([tag]) * 32, b"overlay-prop")
+    xs = [int(x) - ss.SHARE_OFFSET for x in range(total)]
+    shares = np.asarray(ss.make_shares(jnp.asarray(q), k, total))
+    blind_rows = cm.vss_blind_rows_bytes(blind_bytes, c, k, xs)
+    return comms, shares, blind_rows, xs
+
+
+def _sum_instances(insts):
+    grids = cm.sum_commitment_grids([i[0] for i in insts])
+    rows = np.sum(np.stack([i[1] for i in insts]), axis=0)
+    blinds = cm.sum_blind_row_tensors([i[2] for i in insts])
+    return grids, rows, blinds
+
+
+def test_sum_commitment_grids_commutes_and_associates():
+    insts = [_overlay_instance(t) for t in range(4)]
+    grids = [i[0] for i in insts]
+    flat = cm.sum_commitment_grids(grids)
+    # commutativity: every permutation sums to the same grid
+    for perm in ([3, 1, 0, 2], [2, 3, 1, 0], [1, 0, 3, 2]):
+        assert np.array_equal(flat,
+                              cm.sum_commitment_grids([grids[p]
+                                                       for p in perm]))
+    # associativity: nested partial sums — any tree shape — agree
+    left = cm.sum_commitment_grids([
+        cm.sum_commitment_grids(grids[:2]),
+        cm.sum_commitment_grids(grids[2:])])
+    skew = cm.sum_commitment_grids([
+        cm.sum_commitment_grids([grids[0],
+                                 cm.sum_commitment_grids(grids[1:3])]),
+        grids[3]])
+    assert np.array_equal(flat, left)
+    assert np.array_equal(flat, skew)
+
+
+def test_sum_blind_row_tensors_matches_scalar_sums():
+    insts = [_overlay_instance(10 + t) for t in range(3)]
+    tens = cm.sum_blind_row_tensors([i[2] for i in insts])
+    ints = cm.sum_blind_rows([i[2] for i in insts])
+    s, c = tens.shape[0], tens.shape[1]
+    for si in range(s):
+        for ci in range(c):
+            assert int.from_bytes(tens[si, ci].tobytes(),
+                                  "little") == ints[si][ci]
+    # tensor summation nests like the grids do
+    nested = cm.sum_blind_row_tensors(
+        [cm.sum_blind_row_tensors([insts[0][2], insts[1][2]]),
+         insts[2][2]])
+    assert np.array_equal(tens, nested)
+
+
+def test_partial_sum_reverification_equals_flat():
+    insts = [_overlay_instance(20 + t) for t in range(4)]
+    xs = insts[0][3]
+    # flat: every instance verifies individually (exact single checks)
+    for comms, rows, blinds, _ in insts:
+        assert cm.vss_verify_multi([(comms, xs, rows, blinds)])
+    # one whole-tree aggregate verifies against the summed grid
+    grids, rows, blinds = _sum_instances(insts)
+    assert grids is not None
+    assert cm.vss_verify_multi([(grids, xs, rows, blinds)])
+    # arbitrary tree shapes: partial sums re-verify at every interior
+    # node, and the root over partial sums equals the flat sum
+    for split in (1, 2, 3):
+        lo = _sum_instances(insts[:split])
+        hi = _sum_instances(insts[split:])
+        assert cm.vss_verify_multi([(lo[0], xs, lo[1], lo[2])])
+        assert cm.vss_verify_multi([(hi[0], xs, hi[1], hi[2])])
+        root = (cm.sum_commitment_grids([lo[0], hi[0]]),
+                lo[1] + hi[1],
+                cm.sum_blind_row_tensors([lo[2], hi[2]]))
+        assert np.array_equal(root[0], grids)
+        assert cm.vss_verify_multi([(root[0], xs, root[1], root[2])])
+
+
+def test_aggregate_detects_corrupted_member():
+    insts = [_overlay_instance(30 + t) for t in range(3)]
+    xs = insts[0][3]
+    comms, rows, blinds, _ = insts[1]
+    bad_rows = rows.copy()
+    bad_rows[0, 0] += 1
+    insts[1] = (comms, bad_rows, blinds, xs)
+    grids, rows_sum, blinds_sum = _sum_instances(insts)
+    # a lone cheater cannot hide inside the aggregate: the summed-shares
+    # vs summed-commitments equation fails (1 - 2^-128)
+    assert not cm.vss_verify_multi([(grids, xs, rows_sum, blinds_sum)])
+    # and the per-member fallback pinpoints exactly the corrupted one
+    verdicts = [cm.vss_verify_multi([(c_, xs, r_, b_)])
+                for c_, r_, b_, _ in insts]
+    assert verdicts == [True, False, True]

@@ -33,7 +33,7 @@ def test_device_peers_mint_real_blocks():
     mesh = _mesh()
     n_dev = math.prod(mesh.devices.shape)
     cfg = BiscottiConfig(
-        num_nodes=n_dev, dataset="creditcard", base_port=25510,
+        num_nodes=n_dev, dataset="creditcard", base_port=15510,
         num_verifiers=1, num_miners=1, num_noisers=1,
         secure_agg=False, noising=False, verification=True,
         defense=Defense.NONE, convergence_error=0.0, sample_percent=1.0,
@@ -60,7 +60,7 @@ def test_stepper_shared_metric_memoizes():
     mesh = _mesh()
     n_dev = math.prod(mesh.devices.shape)
     cfg = BiscottiConfig(
-        num_nodes=n_dev, dataset="creditcard", base_port=25530,
+        num_nodes=n_dev, dataset="creditcard", base_port=15530,
         num_verifiers=1, num_miners=1, num_noisers=1, batch_size=8,
         timeouts=FAST, seed=3,
     )
@@ -86,7 +86,7 @@ def test_device_cluster_with_secure_agg():
     mesh = _mesh()
     n_dev = math.prod(mesh.devices.shape)
     cfg = BiscottiConfig(
-        num_nodes=n_dev, dataset="creditcard", base_port=25520,
+        num_nodes=n_dev, dataset="creditcard", base_port=15520,
         num_verifiers=1, num_miners=1, num_noisers=1,
         secure_agg=True, noising=True, verification=True,
         defense=Defense.NONE, convergence_error=0.0, sample_percent=1.0,

@@ -61,7 +61,7 @@ def test_kill_and_restart_rejoins_and_chain_matches():
     still-propagating tip can break."""
     from biscotti_tpu.runtime.membership import surviving_prefix_oracle
 
-    n, port = 4, 25210
+    n, port = 4, 15210
     victim = 3
     # enough rounds that the cluster is still mid-training when the victim
     # rejoins — otherwise the reborn peer finds a finished, dead network
@@ -132,7 +132,7 @@ class PartitionedPeer(PeerAgent):
 
 
 def test_partition_window_heals_and_chain_matches():
-    n, port = 4, 25220
+    n, port = 4, 15220
     minority = {3}
 
     async def go():
@@ -198,7 +198,7 @@ def test_geo_latency_model_and_cluster():
     assert lat("h", 9005) == 0.08         # far region
     assert lat("h", 9999) == 0.0          # out-of-range port: no charge
 
-    n, port, rtt = 4, 25240, 0.05
+    n, port, rtt = 4, 15240, 0.05
 
     async def go(regions):
         from biscotti_tpu.runtime.rpc import geo_latency as gl
@@ -265,7 +265,7 @@ def test_declines_complete_the_mint_condition():
 
     from biscotti_tpu.config import Timeouts
 
-    n, port = 7, 25280  # disjoint from the geo test's 25240-25263 block
+    n, port = 7, 15280  # disjoint from the geo test's 15240-15263 block
     slow = Timeouts(update_s=25.0, block_s=40.0, krum_s=3.0, share_s=25.0,
                     rpc_s=6.0)
     from biscotti_tpu.ledger.chain import Blockchain

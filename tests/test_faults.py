@@ -246,7 +246,7 @@ def test_call_releases_probe_slot_on_unexpected_exception():
     # an error OUTSIDE the transport set (a codec bug, a cancellation)
     # records no breaker outcome — the held half-open probe slot must be
     # handed back or the peer stays quarantined indefinitely
-    agent = PeerAgent(_cfg(0, 2, 25300, breaker_threshold=1,
+    agent = PeerAgent(_cfg(0, 2, 14500, breaker_threshold=1,
                            breaker_cooldown_s=0.0))
     agent.health.record_failure(1)
     assert agent.health.state(1) == faults.OPEN
@@ -276,7 +276,7 @@ def test_breaker_success_resets_failure_streak():
 
 
 def test_call_retries_transport_failures_then_succeeds():
-    agent = PeerAgent(_cfg(0, 2, 25300))
+    agent = PeerAgent(_cfg(0, 2, 14500))
     attempts = []
 
     async def flaky(host, port, msg_type, meta, arrays, timeout,
@@ -301,7 +301,7 @@ def test_call_retries_transport_failures_then_succeeds():
 def test_call_does_not_retry_protocol_errors():
     from biscotti_tpu.runtime.rpc import RPCError
 
-    agent = PeerAgent(_cfg(0, 2, 25300))
+    agent = PeerAgent(_cfg(0, 2, 14500))
     calls = []
 
     async def reject(host, port, msg_type, meta, arrays, timeout,
@@ -318,7 +318,7 @@ def test_call_does_not_retry_protocol_errors():
 
 
 def test_call_fails_fast_when_breaker_open():
-    agent = PeerAgent(_cfg(0, 2, 25300, breaker_cooldown_s=60.0))
+    agent = PeerAgent(_cfg(0, 2, 14500, breaker_cooldown_s=60.0))
 
     async def boom(host, port, msg_type, meta, arrays, timeout,
                    attempt=0, **kw):
@@ -383,7 +383,7 @@ def test_chaos_cluster_drop_and_delay_completes_with_equal_chains():
     """Acceptance: 4-node live-TCP cluster, 10% frame drop + 50 ms delay
     injection, training completes with equal chains on all peers, and the
     applied fault schedule is byte-reproducible from the seed."""
-    n, port = 4, 25310
+    n, port = 4, 14510
     plan = FaultPlan(seed=11, drop=0.10, delay=0.25, delay_s=0.05)
 
     async def go():
@@ -425,7 +425,7 @@ def test_breaker_quarantines_killed_peer_and_readmits_on_rejoin():
     rejoin must prove the EVENT-DRIVEN path (the reborn peer's inbound
     announce expires the cooldown, note_inbound) rather than winning a
     race against the cooldown clock."""
-    n, port = 4, 25330
+    n, port = 4, 14530
     victim = 3
     iters = 18
     kw = dict(max_iterations=iters, breaker_threshold=3,
@@ -511,11 +511,11 @@ def test_breaker_quarantines_killed_peer_and_readmits_on_rejoin():
 @pytest.mark.slow
 @pytest.mark.chaos
 @pytest.mark.parametrize("port,case", [
-    (25400, dict(drop=0.20)),
-    (25420, dict(delay=1.0, delay_s=0.08)),
-    (25440, dict(duplicate=0.30)),
-    (25460, dict(reset=0.15)),
-    (25480, dict(drop=0.10, delay=0.50, delay_s=0.05, duplicate=0.10,
+    (14600, dict(drop=0.20)),
+    (14620, dict(delay=1.0, delay_s=0.08)),
+    (14640, dict(duplicate=0.30)),
+    (14660, dict(reset=0.15)),
+    (14680, dict(drop=0.10, delay=0.50, delay_s=0.05, duplicate=0.10,
                  reset=0.05)),
 ], ids=["drop20", "delay100", "dup30", "reset15", "mixed"])
 def test_chaos_matrix_chain_equality(port, case):

@@ -66,10 +66,10 @@ def test_loopback_call_roundtrip_readonly_views_and_accounting():
     async def scenario():
         hub = LoopbackHub()
         callee_reg, caller_reg = MetricsRegistry(), MetricsRegistry()
-        agent = _FakeAgent(1, 27801, metrics=callee_reg)
+        agent = _FakeAgent(1, 13801, metrics=callee_reg)
         ep = hub.register(agent)
-        assert hub.lookup("127.0.0.1", 27801) is ep
-        assert hub.lookup("127.0.0.1", 27999) is None  # remote: TCP
+        assert hub.lookup("127.0.0.1", 13801) is ep
+        assert hub.lookup("127.0.0.1", 13999) is None  # remote: TCP
         assert hub.local_ids == frozenset({1})
 
         sent = np.ones(4)
@@ -110,7 +110,7 @@ def test_loopback_admission_still_sheds_on_fast_path():
         # a zero-rate update bucket sheds the very first delivery
         plan = AdmissionPlan(enabled=True, update_rate=0.001,
                              burst_factor=0.001)
-        agent = _FakeAgent(2, 27802, plan=plan)
+        agent = _FakeAgent(2, 13802, plan=plan)
         ep = hub.register(agent)
         with pytest.raises(BusyError):
             await ep.call("RegisterUpdate", {}, {"a": np.ones(2)},
@@ -125,7 +125,7 @@ def test_loopback_admission_still_sheds_on_fast_path():
 def test_loopback_fault_injection_still_applies():
     async def scenario():
         hub = LoopbackHub()
-        agent = _FakeAgent(3, 27803)
+        agent = _FakeAgent(3, 13803)
         ep = hub.register(agent)
         ones = np.ones(2)
 
@@ -177,7 +177,7 @@ def test_loopback_lifecycle_and_error_mapping():
         async def boom(msg_type, meta, arrays):
             raise KeyError("handler bug")
 
-        agent = _FakeAgent(4, 27804, handler=boom)
+        agent = _FakeAgent(4, 13804, handler=boom)
         ep = hub.register(agent)
         # a handler bug surfaces as RPCError, exactly like the TCP server
         with pytest.raises(RPCError, match="internal"):
@@ -185,7 +185,7 @@ def test_loopback_lifecycle_and_error_mapping():
         # a closed peer's endpoint stops resolving (callers fall to TCP
         # and get connection-refused) and refuses direct delivery
         agent.server.serving = False
-        assert hub.lookup("127.0.0.1", 27804) is None
+        assert hub.lookup("127.0.0.1", 13804) is None
         with pytest.raises(ConnectionError):
             await ep._dispatch("Echo", {}, {}, src=0)
 
@@ -217,7 +217,7 @@ def test_hive_stepper_matches_standalone_trainers():
     from biscotti_tpu.models.trainer import Trainer
 
     n = 3
-    cfg = _cfg(0, n, 27810)
+    cfg = _cfg(0, n, 13810)
     stepper = HiveStepper(cfg, range(n))
     w = np.zeros(stepper.num_params)
 
@@ -265,7 +265,7 @@ def test_hive_stepper_refuses_unequal_shards_and_hive_falls_back(
         return out
 
     monkeypatch.setattr(ds, "load_shard", uneven)
-    cfg = _cfg(0, 3, 27812)
+    cfg = _cfg(0, 3, 13812)
     with pytest.raises(UnequalShardsError, match="unequal"):
         HiveStepper(cfg, range(3))
     h = Hive(cfg, range(3), hive_id="fb")
@@ -279,7 +279,7 @@ def test_light_trainer_holds_no_private_state_and_shares_eval():
     from biscotti_tpu.data import datasets as ds
     from biscotti_tpu.models.trainer import Trainer
 
-    cfg = _cfg(1, 3, 27811)
+    cfg = _cfg(1, 3, 13811)
     full = Trainer(cfg.dataset, ds.shard_name(cfg.dataset, 1, False), cfg=cfg,
                    seed=1)
     light = Trainer(cfg.dataset, ds.shard_name(cfg.dataset, 1, False), cfg=cfg,
@@ -311,7 +311,7 @@ def test_hive_small_cluster_tier1_chains_equal():
     plane and land identical chains, with real loopback traffic counted
     and the per-hive readout surfaced through telemetry."""
     n = 5
-    hive = Hive(_cfg(0, n, 27820), hive_id="t1")
+    hive = Hive(_cfg(0, n, 13820), hive_id="t1")
     results = asyncio.run(hive.run())
     assert len(results) == n
     dumps = {r["chain_dump"] for r in results}
@@ -333,7 +333,7 @@ def test_two_hives_cross_tcp_chains_equal():
     loopback inside each, real TCP between them — holds the cross-hive
     chain-equality oracle that per-process output alone cannot see."""
     n = 6
-    cfg = _cfg(0, n, 27830)
+    cfg = _cfg(0, n, 13830)
     h1 = Hive(cfg, range(0, 3), hive_id="h1")
     h2 = Hive(cfg, range(3, 6), hive_id="h2")
     assert h1.hub.local_ids == frozenset({0, 1, 2})
@@ -362,7 +362,7 @@ def test_chaos_two_hives_hundred_peers_drop_and_churn():
     plan = FaultPlan(seed=23, drop=0.02, delay=0.10, delay_s=0.02,
                      churn=0.05, churn_period=2, churn_down=1)
     assert plan.churn_schedule(n, rounds), "seed must actually churn"
-    cfg = _cfg(0, n, 27600, max_iterations=rounds, fault_plan=plan,
+    cfg = _cfg(0, n, 13700, max_iterations=rounds, fault_plan=plan,
                timeouts=Timeouts(update_s=8.0, block_s=40.0, krum_s=8.0,
                                  share_s=8.0, rpc_s=10.0))
     h1 = Hive(cfg, range(0, 50), hive_id="c1")

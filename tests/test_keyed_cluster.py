@@ -53,7 +53,7 @@ def _run(cfgs, key_dir):
 
 
 def test_keyed_plain_mode_pedersen_commitments(key_dir):
-    port = 25110
+    port = 15110
     results, agents = _run([_cfg(i, port) for i in range(N)], key_dir)
     dumps = [r["chain_dump"] for r in results]
     assert all(d == dumps[0] for d in dumps)
@@ -71,7 +71,7 @@ def test_keyed_plain_mode_pedersen_commitments(key_dir):
 
 
 def test_keyed_secureagg_vss_with_dealer_schnorr(key_dir):
-    port = 25120
+    port = 15120
     cfgs = [_cfg(i, port, secure_agg=True, noising=True) for i in range(N)]
     results, agents = _run(cfgs, key_dir)
     dumps = [r["chain_dump"] for r in results]

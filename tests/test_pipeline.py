@@ -82,7 +82,7 @@ def test_disabled_knobs_reproduce_seed_schedule():
     pre-verification, no micro-batches, no accumulator folds — the round
     schedule is the pre-PR one (the chains-equal test below separately
     proves the enabled engine lands on the same chains)."""
-    n, port = 4, 26110
+    n, port = 4, 13510
     cfgs = [_cfg(i, n, port, secure_agg=True, verification=True,
                  max_iterations=2) for i in range(n)]
 
@@ -127,8 +127,8 @@ def test_pipelined_chaos_chains_equal_to_unpipelined():
         results = await asyncio.gather(*(a.run() for a in agents))
         return agents, results
 
-    agents_on, on = asyncio.run(go(26130, True))
-    _, off = asyncio.run(go(26150, False))
+    agents_on, on = asyncio.run(go(13530, True))
+    _, off = asyncio.run(go(13550, False))
     # both runs individually settle on one chain...
     for results in (on, off):
         equal, common, _ = chaos.chain_oracle(results)
@@ -158,7 +158,7 @@ def test_fork_discards_speculative_step_and_counts_it():
     """A fork landing on the speculated height must discard the
     speculative products (never consume them) and surface the discard in
     telemetry_snapshot() — the rollback half of speculation."""
-    cfg = _cfg(0, 5, 26170, pipeline=True, speculation=True)
+    cfg = _cfg(0, 5, 13570, pipeline=True, speculation=True)
     agent = PeerAgent(cfg)
     # pin the next-round role map: the speculation plane only precomputes
     # for workers, and stake elections need not make node 0 one
@@ -199,7 +199,7 @@ def test_fork_discards_speculative_step_and_counts_it():
 
 
 def test_claim_spec_mismatch_counts_discard():
-    cfg = _cfg(0, 5, 26190, pipeline=True, speculation=True)
+    cfg = _cfg(0, 5, 13590, pipeline=True, speculation=True)
     agent = PeerAgent(cfg)
     agent._spec = {"it": agent.iteration, "base": b"\x00" * 32,
                    "delta": np.zeros(agent.trainer.num_params)}
@@ -268,8 +268,8 @@ def test_batched_intake_bisection_matches_sequential():
     """ISSUE acceptance: one poisoned commitment in a 35-update intake is
     identified (bisection) and rejected EXACTLY as the sequential path
     does — same accepted set, same rejected record, same error."""
-    agent_b, out_b = _run_plain_intake(batch_on=True, port=26210)
-    agent_s, out_s = _run_plain_intake(batch_on=False, port=26230)
+    agent_b, out_b = _run_plain_intake(batch_on=True, port=13610)
+    agent_s, out_s = _run_plain_intake(batch_on=False, port=13630)
     for agent, outcomes in ((agent_b, out_b), (agent_s, out_s)):
         st = agent.round
         assert sorted(st.miner_updates) == [i for i in range(35) if i != 17]
@@ -301,7 +301,7 @@ def test_find_bad_commitments_is_exactly_sequential_verdicts():
 
 
 def test_sig_quorum_batch_fast_path_and_fallback():
-    cfg = _cfg(0, 6, 26250, verification=True, num_verifiers=3)
+    cfg = _cfg(0, 6, 13650, verification=True, num_verifiers=3)
     agent = PeerAgent(cfg)
     agent.role_map = R.RoleMap.build(6, verifiers=[1, 2, 3], miners=[0])
     commitment = b"\xaa" * 32

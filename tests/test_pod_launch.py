@@ -57,7 +57,7 @@ def test_remote_branch_executes_end_to_end_via_sshim(tmp_path, capsys):
     rc = pod_launch.main([
         "--hosts", str(hosts), "--nodes-per-host", "2",
         "--dataset", "creditcard", "--iterations", "1",
-        "--base-port", "25610",
+        "--base-port", "14310",
         "--peers-file", str(peers),
         "--ssh-cmd", "python -m biscotti_tpu.tools.sshim",
         "--scp-cmd", "python -m biscotti_tpu.tools.sshim --scp",
@@ -115,7 +115,7 @@ def test_hive_cmd_exercises_committee_size_at_n1000(tmp_path):
     assert pod_launch.committee_size(500, 1000) == 333  # clamped
     assert pod_launch.committee_size(3, 4) == 1         # small fleets too
     ns = type("A", (), dict(
-        dataset="mnist", base_port=23500, secure_agg=0, noising=0,
+        dataset="mnist", base_port=14350, secure_agg=0, noising=0,
         verification=1, num_miners=500, num_verifiers=3, num_noisers=3,
         iterations=2, seed=3, key_dir=""))()
     cmd = pod_launch.hive_cmd(ns, 0, 1000, 1000, "peers.txt", "hive0")
@@ -160,7 +160,7 @@ def test_hive_mode_live_two_hives_cross_process_chains_equal(tmp_path,
     rc = pod_launch.main([
         "--hosts", str(hosts), "--peers-per-host", "3",
         "--dataset", "creditcard", "--iterations", "2",
-        "--base-port", "27720",
+        "--base-port", "14320",
         "--peers-file", str(tmp_path / "peers.txt"),
         "--timeout", "240",
     ])

@@ -76,18 +76,18 @@ def test_rpc_roundtrip_and_errors():
                 raise rpc.StaleError()
             raise rpc.RPCError("nope")
 
-        server = rpc.RPCServer("127.0.0.1", 24901, handler)
+        server = rpc.RPCServer("127.0.0.1", 14901, handler)
         await server.start()
         try:
-            meta, arrays = await rpc.call("127.0.0.1", 24901, "Echo",
+            meta, arrays = await rpc.call("127.0.0.1", 14901, "Echo",
                                           {"x": 5}, {"a": np.ones(3)},
                                           timeout=5)
             assert meta["got"] == 5
             assert np.array_equal(arrays["a"], np.full(3, 2.0))
             with pytest.raises(rpc.StaleError):
-                await rpc.call("127.0.0.1", 24901, "Stale", timeout=5)
+                await rpc.call("127.0.0.1", 14901, "Stale", timeout=5)
             with pytest.raises(rpc.RPCError):
-                await rpc.call("127.0.0.1", 24901, "Bogus", timeout=5)
+                await rpc.call("127.0.0.1", 14901, "Bogus", timeout=5)
         finally:
             await server.stop()
 
@@ -118,7 +118,7 @@ def _run_cluster(cfgs):
 
 
 def test_cluster_plain_aggregation_chain_equality():
-    n, port = 4, 24910
+    n, port = 4, 14910
     results = _run_cluster([_cfg(i, n, port) for i in range(n)])
     dumps = [r["chain_dump"] for r in results]
     assert all(d == dumps[0] for d in dumps), "chain-equality oracle violated"
@@ -129,7 +129,7 @@ def test_cluster_plain_aggregation_chain_equality():
 
 
 def test_cluster_krum_noising_secureagg():
-    n, port = 5, 24920
+    n, port = 5, 14920
     cfgs = [
         _cfg(i, n, port, secure_agg=True, noising=True, verification=True,
              defense=Defense.KRUM, epsilon=1.0, max_iterations=2)
@@ -145,7 +145,7 @@ def test_cluster_krum_noising_secureagg():
 
 
 def test_cluster_fedsys_mode():
-    n, port = 4, 24930
+    n, port = 4, 14930
     cfgs = [_cfg(i, n, port, fedsys=True, max_iterations=2) for i in range(n)]
     results = _run_cluster(cfgs)
     dumps = [r["chain_dump"] for r in results]
@@ -156,7 +156,7 @@ def test_cluster_fedsys_mode():
 def test_cluster_plain_mode_multiple_miners():
     # regression: with >1 miner only the leader mints, so plain-mode updates
     # must reach every miner, not just the first reachable one
-    n, port = 6, 24950
+    n, port = 6, 14950
     cfgs = [
         _cfg(i, n, port, num_miners=2, num_verifiers=1,
              verification=True, defense=Defense.KRUM, max_iterations=2)
@@ -183,7 +183,7 @@ def test_verifier_bound_updates_carry_no_raw_delta(monkeypatch):
         return orig(u, prefix)
 
     monkeypatch.setattr(P.wire, "pack_update", spy)
-    n, port = 4, 24960
+    n, port = 4, 14960
     cfgs = [
         _cfg(i, n, port, noising=True, verification=True,
              defense=Defense.KRUM, num_verifiers=1, max_iterations=1)
@@ -198,7 +198,7 @@ def test_verifier_bound_updates_carry_no_raw_delta(monkeypatch):
 
 
 def test_late_joiner_adopts_longest_chain():
-    n, port = 3, 24940
+    n, port = 3, 14940
 
     async def go():
         early = [PeerAgent(_cfg(i, n, port, max_iterations=2))
@@ -225,7 +225,7 @@ def test_cluster_cnn_model_secure_agg():
     # commitments, share slices, batched verification and recovery —
     # proves the runtime is not linear-model-only (the reference ran its
     # CNNs only through the in-process ml_main harnesses)
-    n, port = 4, 24970
+    n, port = 4, 14970
     slow = Timeouts(update_s=25.0, block_s=90.0, krum_s=15.0, share_s=25.0,
                     rpc_s=20.0)
     cfgs = [
@@ -269,7 +269,7 @@ def test_register_peer_chain_omission_gates_on_weight_not_length():
         return c
 
     async def go():
-        port = 24990
+        port = 14990
         agent = PeerAgent(_cfg(0, 2, port))
         agent.chain = chain_with(nonempty=5, empty=0)  # heavy: key (5, 6)
 
@@ -303,7 +303,7 @@ def test_cluster_robust_defenses_live():
             (Defense.MULTIKRUM, True),
             (Defense.FOOLSGOLD, True),
             (Defense.TRIMMED_MEAN, False)]):
-        n, port = 5, 25010 + 10 * j
+        n, port = 5, 14700 + 10 * j
         cfgs = [
             _cfg(i, n, port, secure_agg=secagg, noising=True,
                  verification=True, defense=defense, epsilon=1.0,
@@ -326,7 +326,7 @@ def test_trimmed_mean_miner_aggregation_is_trimmed():
 
     from biscotti_tpu.ops.robust_agg import trimmed_mean_aggregate
 
-    n, port = 5, 25040
+    n, port = 5, 14750
     cfgs = [
         _cfg(i, n, port, secure_agg=False, noising=False,
              verification=True, defense=Defense.TRIMMED_MEAN,

@@ -348,7 +348,7 @@ def test_live_keyed_cluster_scrape_mid_run(key_dir):
     from biscotti_tpu.runtime.peer import PeerAgent
     from biscotti_tpu.tools import obs
 
-    port = 25500
+    port = 15500
     ports = [port + i for i in range(N)]
 
     async def go():
@@ -398,7 +398,7 @@ def test_metrics_rpc_tail_sanitizes_unserializable_fields():
     Metrics RPC must sanitize tail events, not die in dispatch."""
     from biscotti_tpu.runtime.peer import PeerAgent
 
-    agent = PeerAgent(_cfg(0, 25560, num_nodes=2))
+    agent = PeerAgent(_cfg(0, 15560, num_nodes=2))
     agent.tele.recorder.record("odd", obj=object())
     reply, _ = asyncio.run(agent._h_metrics({"tail": 5}, {}))
     json.dumps(reply)  # must survive the strict wire encoding
@@ -416,7 +416,7 @@ def test_run_result_keeps_legacy_keys():
 
     from biscotti_tpu.runtime.peer import PeerAgent
 
-    port = 25550
+    port = 15550
     with tempfile.TemporaryDirectory() as td:
         logs = [os.path.join(td, f"n{i}.jsonl") for i in range(2)]
 
