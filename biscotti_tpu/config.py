@@ -311,6 +311,16 @@ class BiscottiConfig:
     # buffered per JSONL write; flush happens at round end and shutdown)
     recorder_ring: int = 4096
     recorder_batch: int = 256
+    # distributed tracing (docs/OBSERVABILITY.md §Distributed tracing):
+    # trace=True threads a compact trace context (trace_id, parent span,
+    # round) through every RPC frame toward trace-capable peers
+    # (negotiated via the RegisterPeer capability set like wire codecs),
+    # opens a child span per dispatched RPC on both transport seams, and
+    # stamps span/parent ids on recorder spans/events — the raw material
+    # tools/trace_round stitches into one cross-peer round timeline.
+    # Default OFF = every frame and recorder event bit-identical to the
+    # pre-tracing format (guarded by tests/test_tracing.py).
+    trace: bool = False
 
     # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
     learning_rate: float = 1e-3  # torch-path SGD lr (used by optimizer-step modes)
@@ -403,6 +413,14 @@ class BiscottiConfig:
             raise ValueError("deadline_floor_s must be > 0")
         if self.snapshot_tail < 1:
             raise ValueError("snapshot_tail must be >= 1")
+        # tracing rides the flight recorder and the span plane; with
+        # telemetry off it would silently record nothing — refuse the
+        # dead configuration (same policy as speculation-sans-pipeline)
+        if self.trace and not self.telemetry:
+            raise ValueError(
+                "trace=True requires telemetry=True (trace context and "
+                "span ids ride the flight recorder; "
+                "docs/OBSERVABILITY.md §Distributed tracing)")
         # the overlay needs a real subtree to aggregate over — an armed
         # flag without a group would silently run the flat fan-out
         # labeled as an overlay run; refuse the dead configuration
@@ -716,6 +734,15 @@ class BiscottiConfig:
         p.add_argument("--recorder-batch", type=int,
                        default=BiscottiConfig.recorder_batch,
                        help="events buffered per batched JSONL write")
+        p.add_argument("--trace", type=int,
+                       default=int(BiscottiConfig.trace),
+                       help="1 arms distributed tracing: trace context "
+                            "on every RPC frame toward trace-capable "
+                            "peers, a child span per dispatched RPC, "
+                            "span/parent ids on recorder events "
+                            "(tools/trace_round stitches the cross-peer "
+                            "round timeline; 0 = frames bit-identical "
+                            "to the untraced format)")
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
@@ -781,6 +808,7 @@ class BiscottiConfig:
             metrics_port=getattr(ns, "metrics_port", cls.metrics_port),
             recorder_ring=getattr(ns, "recorder_ring", cls.recorder_ring),
             recorder_batch=getattr(ns, "recorder_batch", cls.recorder_batch),
+            trace=bool(getattr(ns, "trace", cls.trace)),
             fault_plan=FaultPlan(
                 seed=getattr(ns, "fault_seed", FaultPlan.seed),
                 drop=getattr(ns, "fault_drop", FaultPlan.drop),

@@ -51,6 +51,7 @@ per-peer memory account (peak RSS / peers).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import json
 import math
@@ -163,8 +164,17 @@ class LoopbackEndpoint:
                 await asyncio.sleep(agent.server.service_delay_s)
             meta2 = dict(meta or {})
             arrays2 = {k: _ro_view(v) for k, v in (arrays or {}).items()}
+            # distributed tracing: the loopback dispatch is a transport
+            # seam like RPCServer._dispatch — the same receiver-side
+            # child span off the frame's wire context, so co-hosted hops
+            # appear in the causal tree exactly as TCP hops do (getattr:
+            # harness stubs duck-type the server without the hook)
+            tele = getattr(agent.server, "telemetry", None)
+            span = (tele.rpc_span(msg_type, meta2) if tele is not None
+                    else contextlib.nullcontext())
             try:
-                return await agent._handle(msg_type, meta2, arrays2)
+                with span:
+                    return await agent._handle(msg_type, meta2, arrays2)
             except (StaleError, BusyError, RPCError):
                 raise
             except asyncio.CancelledError:

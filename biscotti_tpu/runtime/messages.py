@@ -22,6 +22,14 @@ pipeline (the telemetry label). Arrays without a tag are the legacy raw
 encoding byte-for-byte, so an old peer's frames decode unchanged and a
 raw64-negotiated frame is bit-identical to the seed format.
 
+Distributed tracing (telemetry/tracectx.py): toward peers that
+advertised the `trace` capability, meta carries one compact entry
+`"_tr": [trace_id, span_id, round]` — the sender's current span, which
+the receiver's dispatch span adopts as parent. It is ordinary meta:
+this codec neither adds nor strips it, so untraced frames are
+byte-identical to the pre-tracing format, and a chunked payload carries
+it in the header that rides the head of the continuation run.
+
 Chunked streaming: a payload larger than `chunk_bytes` is emitted as a
 run of continuation frames, each payload-prefixed with CHUNK_MAGIC + a
 flags byte (bit 0 = last). rpc.FrameStream reassembles the run back into

@@ -29,6 +29,7 @@ main.go:1481-1482, cannot occur).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import os
 import random
@@ -55,7 +56,7 @@ from biscotti_tpu.runtime import overlay as ov
 from biscotti_tpu.runtime import stragglers
 from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import BusyError, RPCError, StaleError
-from biscotti_tpu.telemetry import Telemetry, serve_metrics
+from biscotti_tpu.telemetry import Telemetry, serve_metrics, tracectx
 from biscotti_tpu.tools import keygen
 
 
@@ -283,6 +284,13 @@ class PeerAgent:
         # always bit-exact and all crypto survives compression.
         self.wire = wcodecs.get(cfg.wire_codec)
         self.caps = wcodecs.capabilities(cfg.wire_codec)
+        if cfg.trace:
+            # distributed tracing: advertise the `trace` capability in
+            # the RegisterPeer hello — trace context is attached only
+            # toward peers that advertised it back, so legacy/untraced
+            # peers keep receiving byte-identical frames (negotiated
+            # exactly like the wire codecs above)
+            self.caps = frozenset(self.caps | {tracectx.TRACE_CAP})
         # hierarchical aggregation overlay (runtime/overlay.py,
         # docs/OVERLAY.md): the deterministic per-round tree this peer
         # routes bulk fan-out through. Inactive (seed-identical flat
@@ -368,7 +376,8 @@ class PeerAgent:
                               # per-peer labels (biscotti_breaker_state)
                               # must fit the whole cluster before the
                               # cardinality cap starts collapsing series
-                              max_label_sets=max(256, 4 * cfg.num_nodes))
+                              max_label_sets=max(256, 4 * cfg.num_nodes),
+                              trace=cfg.trace)
         # per-phase wall-clock accounting (SURVEY §5.1): totals come back
         # in run()'s result; eval/eval_cost_breakdown.py aggregates them
         self.phases = self.tele.phases
@@ -393,6 +402,12 @@ class PeerAgent:
         # reply-codec capability set for the RPC server: callers request
         # a reply codec via `acodec`, granted iff inside OUR caps
         self.server.caps = self.caps
+        # distributed tracing: arm the transport seams' receiver-side
+        # dispatch spans (rpc.RPCServer._dispatch + the hive loopback
+        # dispatch both read server.telemetry); None keeps the seed
+        # span-free dispatch path
+        if self.tele.trace:
+            self.server.telemetry = self.tele
         # hive co-hosting (runtime/hive.py, docs/HIVE.md): register with
         # the process-local LoopbackHub and attach it to the pool, so
         # RPCs toward co-hosted peers skip TCP framing and serialization
@@ -636,14 +651,33 @@ class PeerAgent:
         reply = {"snapshot": self.telemetry_snapshot(),
                  "prom": self.tele.render()}
         tail = int(meta.get("tail", 0) or 0)
-        if tail > 0:
+        since = meta.get("since_seq")
+        if tail > 0 or since is not None:
             # the recorder tolerates unserializable field values (its
             # spill uses default=str) but the wire codec is strict JSON —
             # sanitize the same way before the events enter the reply
             import json as _json
 
-            reply["events"] = _json.loads(_json.dumps(
-                self.tele.recorder.tail(min(tail, 1000)), default=str))
+            page = min(tail, 1000) if tail > 0 else 1000
+            if since is not None:
+                # incremental poll (tools/obs --watch, tools/trace_round):
+                # only events past the caller's cursor, a bounded page at
+                # a time — re-fetching the full ring every scrape is what
+                # this cursor exists to stop. `last_seq` advances the
+                # cursor even on an empty page; a first event with
+                # seq > since_seq + 1 means the ring wrapped past the
+                # cursor (the poller fell behind eviction).
+                try:
+                    since = max(0, int(since))
+                except (TypeError, ValueError):
+                    raise RPCError("since_seq must be an integer")
+                events = self.tele.recorder.tail_since(since, limit=page)
+                reply["last_seq"] = (events[-1]["seq"] if events
+                                     else max(since, self.tele.recorder.seq))
+            else:
+                events = self.tele.recorder.tail(page)
+            reply["seq"] = self.tele.recorder.seq
+            reply["events"] = _json.loads(_json.dumps(events, default=str))
         return reply, {}
 
     def _sign(self, message: bytes) -> bytes:
@@ -758,6 +792,14 @@ class PeerAgent:
             out["achunk"] = chunk
         return out
 
+    def _peer_traces(self, pid: int) -> bool:
+        """True when trace context should ride frames toward `pid`:
+        WE trace and the peer advertised the `trace` capability in its
+        hello — the same all-or-nothing negotiation the wire codecs use,
+        so legacy peers (and mixed clusters) get untouched frames."""
+        return (self.tele.trace
+                and tracectx.TRACE_CAP in (self.peer_caps.get(pid) or ()))
+
     def _record_caps(self, pid: int, caps) -> None:
         """Record a peer's advertised capability set from a hello or a
         hello reply. A hello WITHOUT a capability set resets the entry
@@ -849,9 +891,25 @@ class PeerAgent:
                     i_am_probe = True  # that allow() claimed the slot
             try:
                 codec, chunk = self._wire_to(peer_id)
-                out = await self.pool.call(host, port, msg_type, meta,
-                                           arrays, timeout, attempt=attempt,
-                                           codec=codec, chunk_bytes=chunk)
+                # distributed tracing: each ATTEMPT is its own wire
+                # exchange, so each gets its own client span whose id
+                # rides the frame (`_tr`) — the receiver's dispatch span
+                # adopts it as parent, and the request/reply midpoints
+                # of exactly this span pair are what trace_round's
+                # clock-offset estimator aligns on
+                if self._peer_traces(peer_id):
+                    ctx = self.tele.new_ctx()
+                    send_meta = tracectx.stamp(meta, ctx)
+                    span = self.tele.span("rpc_call", it=self.iteration,
+                                          ctx=ctx, peer=peer_id,
+                                          msg=msg_type)
+                else:
+                    send_meta, span = meta, contextlib.nullcontext()
+                with span:
+                    out = await self.pool.call(host, port, msg_type,
+                                               send_meta, arrays, timeout,
+                                               attempt=attempt, codec=codec,
+                                               chunk_bytes=chunk)
                 self._record_peer_ok(peer_id)
                 return out
             except StaleError:
@@ -1620,6 +1678,14 @@ class PeerAgent:
             # re-checked at send time inside push(): a co-hosted peer that
             # died in between gets the ConnectionError a closed TCP socket
             # would raise, never a silent drop.
+            # distributed tracing: the broadcast inherits the CURRENT
+            # span (the mint / the handler that accepted the block) as
+            # the receivers' parent — stamped once per traced group, so
+            # the encode-once-per-group optimization survives and
+            # untraced/legacy groups keep byte-identical frames
+            wctx = tracectx.current() if self.tele.trace else None
+            meta_tr = tracectx.stamp(meta, wctx) if wctx is not None \
+                else meta
             loopback_pids = frozenset(
                 pid for pid in targets
                 if self.pool.loopback_endpoint(*self.peers[pid]) is not None)
@@ -1635,18 +1701,20 @@ class PeerAgent:
                     blk.iteration, self.id)
             relayed_pids = frozenset(t for ts in relayed_plan.values()
                                      for t in ts)
-            frames: Dict[Tuple[str, int], Tuple[bytes, str]] = {}
-            group: Dict[int, Tuple[str, int]] = {}
+            frames: Dict[Tuple[str, int, bool], Tuple[bytes, str]] = {}
+            group: Dict[int, Tuple[str, int, bool]] = {}
             for pid in targets:
                 if pid in loopback_pids or pid in relayed_pids:
                     continue
-                key = self._wire_to(pid)
+                traced = wctx is not None and self._peer_traces(pid)
+                key = self._wire_to(pid) + (traced,)
                 group[pid] = key
                 if key not in frames:
-                    codec, chunk = key
+                    codec, chunk, traced = key
                     stats: Dict[str, int] = {}
                     frame = msgs.encode(
-                        "RegisterBlock", meta, arrays,
+                        "RegisterBlock", meta_tr if traced else meta,
+                        arrays,
                         codec=None if codec == wcodecs.RAW else codec,
                         chunk_bytes=chunk, stats=stats)
                     eff = str(stats.get("codec", wcodecs.RAW))
@@ -1660,8 +1728,9 @@ class PeerAgent:
                 try:
                     if pid in loopback_pids:
                         await self.pool.post_direct(
-                            host, port, "RegisterBlock", meta, arrays,
-                            timeout=self.timeouts.rpc_s)
+                            host, port, "RegisterBlock",
+                            meta_tr if self._peer_traces(pid) else meta,
+                            arrays, timeout=self.timeouts.rpc_s)
                     else:
                         frame, eff = frames[group[pid]]
                         await self.pool.post(host, port, frame,
@@ -3693,38 +3762,47 @@ class PeerAgent:
         t0 = time.monotonic()
         grace_until = None
         accounted_set: Set[int] = set()
-        try:
-            while time.monotonic() - t0 < deadline:
-                have_keys = (self._sec_sources(st) if sec
-                             else set(st.miner_updates))
-                have = len(have_keys)
-                # every expected contributor has responded — a submission, a
-                # provably bad one, or a signed decline (verifier-refused
-                # workers, RegisterDecline): mint at once. Union-counted so a
-                # Byzantine worker both declining and submitting is one peer.
-                accounted_set = (have_keys | st.miner_rejected.keys()
-                                 | st.miner_declined)
-                accounted = len(accounted_set)
-                # stall forensics: while blocked, publish exactly who this
-                # intake is waiting on (the obs `waiting-on` column)
-                self.straggler.waiting(
-                    phase, (n for n in expected if n not in accounted_set
-                            and n != self.id))
-                if accounted >= cfg.num_samples:
-                    break
-                if have >= target:
-                    # quorum reached — hold a short straggler window so
-                    # same-instant submissions (and their rejections) land in
-                    # this block rather than silently missing the round
-                    if grace_until is None:
-                        grace_until = time.monotonic() + min(1.0, deadline / 4)
-                    elif time.monotonic() >= grace_until:
+        # the intake wait is a tracing-only span: under the cross-peer
+        # timeline the miner's "waiting for shares" window is a real
+        # critical-path segment (parked), not untraced dead air
+        with self.tele.trace_span("intake_wait", it=it):
+            try:
+                while time.monotonic() - t0 < deadline:
+                    have_keys = (self._sec_sources(st) if sec
+                                 else set(st.miner_updates))
+                    have = len(have_keys)
+                    # every expected contributor has responded — a
+                    # submission, a provably bad one, or a signed decline
+                    # (verifier-refused workers, RegisterDecline): mint at
+                    # once. Union-counted so a Byzantine worker both
+                    # declining and submitting is one peer.
+                    accounted_set = (have_keys | st.miner_rejected.keys()
+                                     | st.miner_declined)
+                    accounted = len(accounted_set)
+                    # stall forensics: while blocked, publish exactly who
+                    # this intake is waiting on (the obs `waiting-on`
+                    # column)
+                    self.straggler.waiting(
+                        phase, (n for n in expected
+                                if n not in accounted_set
+                                and n != self.id))
+                    if accounted >= cfg.num_samples:
                         break
-                if st.block_done and st.block_done.is_set():
-                    return  # someone else minted first
-                await asyncio.sleep(0.05)
-        finally:
-            self.straggler.clear(phase)
+                    if have >= target:
+                        # quorum reached — hold a short straggler window
+                        # so same-instant submissions (and their
+                        # rejections) land in this block rather than
+                        # silently missing the round
+                        if grace_until is None:
+                            grace_until = time.monotonic() + min(
+                                1.0, deadline / 4)
+                        elif time.monotonic() >= grace_until:
+                            break
+                    if st.block_done and st.block_done.is_set():
+                        return  # someone else minted first
+                    await asyncio.sleep(0.05)
+            finally:
+                self.straggler.clear(phase)
         # feed the controller BOTH outcomes: a satisfied intake records
         # its real completion time, and an EXPIRED one records the full
         # wait (== the deadline) — so a fleet that slowed past the
@@ -3755,9 +3833,15 @@ class PeerAgent:
             self._trace("straggler_excluded", phase=phase,
                         peers=missing, short=shortfall,
                         waited_s=round(time.monotonic() - t0, 3))
-        blk = await self._create_block()
-        if blk is not None:
-            self._accept_block(blk, gossip=True, minted=True)
+        # tracing-only composite span: the recovery/verify child spans
+        # inside _create_block hang off it, and the broadcast below
+        # stamps it as the receivers' parent — the settle leg of the
+        # cross-peer causal tree (the gossip fan-out reads the CURRENT
+        # context, which inside this block is the mint span)
+        with self.tele.trace_span("mint", it=it):
+            blk = await self._create_block()
+            if blk is not None:
+                self._accept_block(blk, gossip=True, minted=True)
 
     async def _create_block(self) -> Optional[Block]:
         cfg = self.cfg
@@ -4000,6 +4084,16 @@ class PeerAgent:
         if self.role_map.is_miner(self.id) and self.cfg.secure_agg:
             st.my_xs = self._my_share_xs()
         self._round_t0 = time.monotonic()
+        if self.tele.trace:
+            # root the round's causal tree: every peer derives the SAME
+            # trace id for iteration `it` (pure function of the protocol
+            # seed), so the N per-peer trees stitch into one cluster-wide
+            # round trace. The root context is installed on THIS task, and
+            # create_task's context copy threads it into the worker/miner
+            # flows, watchdogs, and gossip pushes below; the round_start
+            # event below carries the root span id (its `parent` field),
+            # which is how trace_round finds each peer's root.
+            self.tele.round_root(tracectx.trace_id_for(cfg.seed, it), it)
         self._trace("round_start",
                     verifier=self.role_map.is_verifier(self.id),
                     miner=self.role_map.is_miner(self.id))
@@ -4073,7 +4167,10 @@ class PeerAgent:
                 stragglers.BLOCK,
                 [leader] if leader is not None and leader != self.id
                 else [])
-            await asyncio.wait_for(st.block_done.wait(), block_dl)
+            # tracing-only: the block wait is most of a non-miner's round
+            # — under the timeline it is an explicit parked segment
+            with self.tele.trace_span("block_wait", it=it):
+                await asyncio.wait_for(st.block_done.wait(), block_dl)
             self.straggler.clear(stragglers.BLOCK)
             # a block landed: the completed round duration is the
             # controller's primary signal for next round's block budget

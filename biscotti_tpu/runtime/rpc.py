@@ -35,6 +35,7 @@ carry the request's rid so out-of-order completion is fine.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import errno
 import struct
 import time
@@ -380,6 +381,13 @@ class RPCServer:
         # FrameStream's mid-frame deadline on inbound connections
         self.admission = None
         self.read_deadline = 0.0
+        # distributed tracing (telemetry/tracectx.py, docs/OBSERVABILITY.md
+        # §Distributed tracing): when the owning peer armed tracing, this
+        # holds its Telemetry and every dispatched RPC runs inside a
+        # child span adopted from the frame's wire context — the
+        # receiver half of the cross-peer causal link. None (default) =
+        # the seed dispatch path, span-free.
+        self.telemetry = None
         # straggler plane (runtime/stragglers.py, docs/STRAGGLERS.md):
         # extra per-RPC service delay charged before every handler
         # dispatch when this peer carries a slow speed profile. Owned by
@@ -558,7 +566,11 @@ class RPCServer:
                 # caller's observed latency grows exactly like a genuinely
                 # slow service's would
                 await asyncio.sleep(self.service_delay_s)
-            rmeta, rarrays = await self.handler(msg_type, meta, arrays)
+            span = (self.telemetry.rpc_span(msg_type, meta)
+                    if self.telemetry is not None
+                    else contextlib.nullcontext())
+            with span:
+                rmeta, rarrays = await self.handler(msg_type, meta, arrays)
         except StaleError as e:
             rmeta, rarrays = {"error": e.reason, "stale": True}, {}
         except BusyError as e:

@@ -19,6 +19,7 @@ telemetry disabled, which swaps in the NULL_* no-op singletons) pulls in
 neither jax nor numpy — asserted by tests/test_telemetry.py's smoke test.
 """
 
+from biscotti_tpu.telemetry import tracectx  # noqa: F401
 from biscotti_tpu.telemetry.core import (  # noqa: F401
     NULL_RECORDER,
     NULL_REGISTRY,

@@ -22,6 +22,14 @@ pre-telemetry accounting cost, nothing more (asserted by the smoke test).
 One carve-out: an explicitly configured spill path keeps a REAL recorder
 even when disabled, because the event log predates this subsystem and
 `--telemetry 0 --log-dir ...` must keep producing it.
+
+Distributed tracing (`trace=True`, cfg.trace, docs/OBSERVABILITY.md
+§Distributed tracing): spans additionally carry (`trace`, `span`,
+`parent`) ids threaded through the telemetry/tracectx contextvar, events
+inherit the enclosing span as their `parent`, and `rpc_span` opens the
+receiver-side child span for one handled RPC off the frame's wire
+context. With tracing off (the default) none of these fields exist and
+every event is byte-identical to the pre-tracing schema.
 """
 
 from __future__ import annotations
@@ -30,6 +38,7 @@ import contextlib
 import time
 from typing import Dict, Optional
 
+from biscotti_tpu.telemetry import tracectx
 from biscotti_tpu.telemetry.recorder import FlightRecorder
 from biscotti_tpu.telemetry.registry import MetricsRegistry
 from biscotti_tpu.utils.profiling import PhaseClock
@@ -92,6 +101,13 @@ class NullRecorder:
     def tail(self, n: int = 50):
         return []
 
+    def tail_since(self, since_seq: int = 0, limit: int = 1000):
+        return []
+
+    @property
+    def seq(self) -> int:
+        return 0
+
     def crash_dump(self, path: str, reason: str = "") -> None:
         return None
 
@@ -105,9 +121,13 @@ class Telemetry:
                  ring: int = 4096, spill_path: str = "",
                  spill_batch: int = 256,
                  registry: Optional[MetricsRegistry] = None,
-                 max_label_sets: int = 256):
+                 max_label_sets: int = 256, trace: bool = False):
         self.node = node
         self.enabled = bool(enabled)
+        # distributed tracing rides the recorder, so it needs the full
+        # telemetry plane on; off (the default) = the pre-tracing event
+        # schema and zero per-span id work
+        self.trace = bool(trace) and self.enabled
         # PhaseClock runs in BOTH modes: its totals are the run() result's
         # back-compat `phases` key and predate this subsystem (its cost is
         # the pre-PR baseline, not telemetry overhead)
@@ -139,23 +159,99 @@ class Telemetry:
     # -------------------------------------------------------------- spans
 
     @contextlib.contextmanager
-    def span(self, name: str, it: Optional[int] = None):
-        """Round-correlated timing context (see module docstring)."""
+    def span(self, name: str, it: Optional[int] = None,
+             ctx: Optional[tracectx.SpanCtx] = None, **fields):
+        """Round-correlated timing context (see module docstring).
+
+        Yields the span's trace context (None unless tracing is on).
+        With tracing on, the span gets an id, adopts the current context
+        as its parent, and IS the current context for its body — so
+        nested spans, events, and outbound RPCs inside it all link to
+        it. `ctx` lets a caller pre-create the context (the client RPC
+        path must stamp the span's id on the frame before entering);
+        `fields` ride the recorder event verbatim."""
+        token = None
+        if self.trace:
+            if ctx is None:
+                ctx = tracectx.child(self.node)
+            token = tracectx.activate(ctx)
         t0 = time.perf_counter()
         try:
-            yield
+            yield ctx
         finally:
             dt = time.perf_counter() - t0
+            if token is not None:
+                tracectx.restore(token)
             self.phases.add(name, dt)
             self._span_hist.observe(dt, phase=name)
+            if ctx is not None:
+                fields = dict(fields, trace=ctx.trace_id, span=ctx.span_id,
+                              parent=ctx.parent)
+                if it is None:
+                    it = ctx.round
             self.recorder.record("span", iter=it, phase=name,
-                                 dur_s=round(dt, 6))
+                                 dur_s=round(dt, 6), **fields)
+
+    @contextlib.contextmanager
+    def rpc_span(self, msg_type: str, meta: Optional[Dict]):
+        """Receiver-side child span for one handled RPC (the server and
+        loopback dispatch seams): adopt the frame's wire context — the
+        SENDER's span — as parent, so the handler's own spans, events,
+        and forwarded calls all hang off the remote cause. A frame
+        WITHOUT context (a legacy/untraced sender, a scraper's one-shot
+        Metrics call) gets no dispatch span — an unparented root would
+        only be ring noise — but the current context is still DETACHED
+        for the handler's duration, so its work cannot mis-attach to
+        whatever span the accept loop happened to run under. Only
+        called when tracing is on."""
+        wctx = tracectx.from_meta(meta)
+        token = tracectx.activate(wctx)  # None detaches — see docstring
+        try:
+            if wctx is None:
+                yield None
+                return
+            with self.span("rpc." + msg_type, it=wctx.round) as ctx:
+                yield ctx
+        finally:
+            tracectx.restore(token)
+
+    def trace_span(self, name: str, it: Optional[int] = None, **fields):
+        """A span that exists ONLY under tracing — for timeline coverage
+        of long waits (block/intake parking) and composite phases (the
+        mint) that the pre-tracing phase accounting never timed. With
+        tracing off this is a free nullcontext: the PhaseClock totals,
+        the phase histogram, and the recorder stream stay exactly the
+        seed's (the bit-identity guard tests this)."""
+        if not self.trace:
+            return contextlib.nullcontext()
+        return self.span(name, it=it, **fields)
+
+    def new_ctx(self) -> tracectx.SpanCtx:
+        """A fresh child context of the current span (for callers that
+        must know the span id before opening the span — the client RPC
+        path stamps it on the outbound frame)."""
+        return tracectx.child(self.node)
+
+    def round_root(self, trace_id: str, it: int) -> tracectx.SpanCtx:
+        """Install a parentless round-root context for the calling task:
+        everything the round's task tree does — worker/miner flows,
+        gossip pushes, watchdogs — inherits it via create_task's context
+        copy. Returns the root ctx (already activated)."""
+        ctx = tracectx.root(trace_id, self.node, it)
+        tracectx.activate(ctx)
+        return ctx
 
     def event(self, name: str, it: Optional[int] = None, **kw) -> None:
         # both sinks are null singletons when their half is off: metrics
         # need enabled=True, the recorder additionally honours a
         # configured spill path (see __init__)
         self._event_ctr.inc(event=name)
+        if self.trace:
+            # point events link into the causal tree as children of the
+            # enclosing span — with tracing off the schema is untouched
+            cur = tracectx.current()
+            if cur is not None and "parent" not in kw:
+                kw = dict(kw, trace=cur.trace_id, parent=cur.span_id)
         self.recorder.record(name, iter=it, **kw)
 
     # ------------------------------------------------------------ readout

@@ -86,11 +86,36 @@ class FlightRecorder:
 
     # ------------------------------------------------------------ readout
 
+    @property
+    def seq(self) -> int:
+        """Sequence number of the newest event (0 = nothing recorded).
+        Pollers use it as the cursor for `tail_since`."""
+        return self._seq
+
     def tail(self, n: int = 50) -> List[Dict]:
         """The newest `n` events, oldest first."""
         if n <= 0:
             return []
         return list(self.ring)[-n:]
+
+    def tail_since(self, since_seq: int = 0, limit: int = 1000) -> List[Dict]:
+        """Cursor read: events with seq > `since_seq`, oldest first,
+        at most `limit` of them — the incremental-poll primitive behind
+        the Metrics RPC's `since_seq` option (tools/obs --watch,
+        tools/trace_round), so a scraper stops re-fetching the whole
+        ring every scrape. Sequence numbers are gapless, so a reply
+        whose first event has seq > since_seq + 1 tells the poller the
+        ring wrapped past its cursor (events were lost to eviction)."""
+        if limit <= 0:
+            return []
+        ring = self.ring
+        if not ring or since_seq >= self._seq:
+            return []
+        first = ring[0]["seq"]
+        # seqs are contiguous in the ring: index straight to the cursor
+        start = max(0, int(since_seq) - first + 1)
+        out = list(ring)[start:start + limit]
+        return out
 
     def crash_dump(self, path: str, reason: str = "") -> Optional[str]:
         """Dump the ENTIRE ring (plus a trailer naming the reason) to

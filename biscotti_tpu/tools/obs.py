@@ -458,21 +458,39 @@ def format_table(merged: Dict) -> str:
 
 
 async def scrape(host: str, ports: List[int], tail: int = 0,
-                 timeout: float = 5.0) -> List[Dict]:
+                 timeout: float = 5.0,
+                 cursors: Optional[Dict[int, int]] = None) -> List[Dict]:
     """Pull every peer's Metrics RPC concurrently; unreachable peers are
     reported as {'unreachable': True} rows rather than sinking the
     scrape (a dead peer is exactly when you want the rest of the
-    table)."""
+    table).
+
+    `cursors` (a mutable {port: last_seq} dict) switches the event tail
+    to the RPC's incremental `since_seq` mode: the FIRST contact with a
+    port keeps the legacy newest-N fetch and seeds the cursor from the
+    reply's head `seq`, then each later scrape fetches only events past
+    the cursor — a bounded page instead of the whole ring. After every
+    fetch the cursor jumps to the ring head, so a beat that produced
+    more events than one page skips forward (exactly what the
+    pre-cursor newest-N view did) instead of lagging ever further
+    behind live. The watch loop passes one dict across iterations, so
+    a long `--watch --tail` session stops re-fetching (and
+    re-printing) the same events every beat."""
     from biscotti_tpu.runtime import rpc
 
     async def one(port: int) -> Dict:
         try:
-            rmeta, _ = await rpc.call(host, port, "Metrics",
-                                      {"tail": tail} if tail else {},
+            meta: Dict = {"tail": tail} if tail else {}
+            if tail and cursors is not None and port in cursors:
+                meta["since_seq"] = cursors[port]
+            rmeta, _ = await rpc.call(host, port, "Metrics", meta,
                                       timeout=timeout)
             snap = rmeta["snapshot"]
             if tail:
                 snap["events"] = rmeta.get("events", [])
+                if cursors is not None:
+                    cursors[port] = int(rmeta.get("seq",
+                                                  cursors.get(port, 0)))
             return snap
         except Exception as e:
             return {"node": None, "port": port, "unreachable": True,
@@ -502,9 +520,14 @@ def main(argv=None) -> int:
     ports = ([int(p) for p in ns.ports.split(",") if p] if ns.ports
              else [ns.base_port + i for i in range(ns.nodes)])
 
+    # watch mode keeps per-port cursors so repeated --tail scrapes pull
+    # only NEW events via the Metrics RPC's since_seq option; a one-shot
+    # scrape keeps the newest-N semantics
+    cursors: Optional[Dict[int, int]] = {} if ns.watch > 0 else None
+
     def once() -> int:
         snaps = asyncio.run(scrape(ns.host, ports, tail=ns.tail,
-                                   timeout=ns.timeout))
+                                   timeout=ns.timeout, cursors=cursors))
         up = [s for s in snaps if not s.get("unreachable")]
         down = [s for s in snaps if s.get("unreachable")]
         merged = merge_snapshots(up)

@@ -37,6 +37,14 @@ import os
 import sys
 from typing import Dict, List
 
+# tracing-only spans that are NOT phase work: waits (the peer parked),
+# wire time (rpc_call covers the await on a reply), and composites
+# whose children are already counted (mint nests recovery/verify).
+# Counting them into serial_s would report waiting as overlapped work.
+_NON_WORK_PHASES = frozenset({
+    "rpc_call", "block_wait", "intake_wait", "mint",
+})
+
 
 def collect_round_table(agents) -> Dict:
     """Aggregate span/trace events from live agents' flight recorders
@@ -49,17 +57,36 @@ def collect_round_table(agents) -> Dict:
     per: Dict[tuple, Dict] = {}
     phases: Dict[str, float] = {}
     batch_sizes: List[int] = []
+    # per-round trace linkage (docs/OBSERVABILITY.md §Distributed
+    # tracing): when the cluster ran with tracing, each overlap row
+    # carries the round's cluster-wide trace id and its span count, so
+    # a row cross-references straight into tools/trace_round output
+    # (and the --chrome-out timeline) by trace id / span id. Majority
+    # vote per iteration: a handful of boundary spans (the block gossip
+    # of round r lands after `iteration` advanced to r+1) straddle
+    # rounds and must not claim the row.
+    trace_votes: Dict[int, Dict[str, int]] = {}
+    span_count: Dict[int, int] = {}
     for a in agents:
         for ev in a.tele.recorder.tail(100000):
             it = ev.get("iter")
             node = ev.get("node")
             name = ev.get("event")
             if name == "span" and it is not None:
+                if ev.get("trace"):
+                    votes = trace_votes.setdefault(it, {})
+                    tid = str(ev["trace"])
+                    votes[tid] = votes.get(tid, 0) + 1
+                    span_count[it] = span_count.get(it, 0) + 1
+                phase = ev.get("phase", "?")
+                if phase in _NON_WORK_PHASES or phase.startswith("rpc."):
+                    # timeline coverage, not phase work: rpc.* dispatch
+                    # spans WRAP handler work whose own spans are counted
+                    continue
                 r = per.setdefault((node, it), {"serial_s": 0.0,
                                                 "start": None, "end": None})
                 dur = float(ev.get("dur_s", 0.0))
                 r["serial_s"] += dur
-                phase = ev.get("phase", "?")
                 phases[phase] = phases.get(phase, 0.0) + dur
             elif name == "round_start" and it is not None:
                 r = per.setdefault((node, it), {"serial_s": 0.0,
@@ -95,6 +122,10 @@ def collect_round_table(agents) -> Dict:
         if wall is not None:
             row["wall_s"] = round(wall, 4)
             row["overlap_s"] = round(overlap, 4)
+        if it in trace_votes:
+            row["trace"] = max(trace_votes[it].items(),
+                               key=lambda kv: kv[1])[0]
+            row["trace_spans"] = span_count.get(it, 0)
         table.append(row)
     return {
         "rounds": table,
@@ -118,7 +149,17 @@ def main(argv=None) -> int:
     ap.add_argument("--base-port", type=int, default=28410)
     ap.add_argument("--json", default="",
                     help="also write the table to this path")
+    ap.add_argument("--trace", type=int, default=1,
+                    help="1 = run the harness cluster with distributed "
+                         "tracing so overlap rows carry trace/span ids "
+                         "and --chrome-out works (0 = untraced)")
+    ap.add_argument("--chrome-out", default="",
+                    help="write the cluster's causal timeline as Chrome "
+                         "trace-event JSON (tools/trace_round exporter; "
+                         "load in Perfetto). Implies --trace 1.")
     args = ap.parse_args(argv)
+    if args.chrome_out:
+        args.trace = 1
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
@@ -137,7 +178,7 @@ def main(argv=None) -> int:
             max_iterations=args.iterations, convergence_error=0.0,
             sample_percent=0.70, seed=2, timeouts=timeouts,
             pipeline=bool(args.pipeline), speculation=bool(args.pipeline),
-            batch_intake=bool(args.pipeline),
+            batch_intake=bool(args.pipeline), trace=bool(args.trace),
         )
         for i in range(args.nodes)
     ]
@@ -154,17 +195,34 @@ def main(argv=None) -> int:
     out["pipeline"] = bool(args.pipeline)
     out["nodes"] = args.nodes
 
-    print(f"{'iter':>5} {'serial_s':>9} {'wall_s':>8} {'overlap_s':>10}")
+    print(f"{'iter':>5} {'serial_s':>9} {'wall_s':>8} {'overlap_s':>10}  "
+          "trace")
     for row in out["rounds"]:
         print(f"{row['iter']:>5} {row['serial_s']:>9.3f} "
               f"{row.get('wall_s', float('nan')):>8.3f} "
-              f"{row.get('overlap_s', 0.0):>10.3f}")
+              f"{row.get('overlap_s', 0.0):>10.3f}  "
+              f"{row.get('trace', '-')}"
+              + (f" ({row['trace_spans']} spans)"
+                 if row.get("trace_spans") else ""))
     print("phase totals:", json.dumps(out["phase_totals_s"]))
     if out["crypto_batch_sizes"]:
         bs = out["crypto_batch_sizes"]
         print(f"crypto batches: n={len(bs)} sizes min/med/max = "
               f"{bs[0]}/{bs[len(bs) // 2]}/{bs[-1]}")
     print("chains_equal:", out["chains_equal"])
+    if args.chrome_out:
+        # reuse the trace_round exporter on the in-process recorders:
+        # same span forest, zero clock skew (one process, one clock)
+        from biscotti_tpu.tools import trace_round as tr
+
+        events = [ev for a in agents for ev in a.tele.recorder.tail(100000)]
+        recon = tr.reconstruct(events, min_nodes=1)
+        obj = tr.chrome_trace(recon["traces"])
+        tr.validate_chrome(obj)
+        with open(args.chrome_out, "w") as f:
+            json.dump(obj, f)
+        print(f"chrome trace: {args.chrome_out} "
+              f"({len(obj['traceEvents'])} events)")
     if args.json:
         os.makedirs(os.path.dirname(os.path.abspath(args.json)),
                     exist_ok=True)

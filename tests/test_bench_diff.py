@@ -1,0 +1,106 @@
+"""tools/bench_diff: key-wise artifact comparison with a regression
+threshold exit code (docs/OBSERVABILITY.md §Comparing bench artifacts)."""
+
+import json
+
+import pytest
+
+from biscotti_tpu.tools import bench_diff as bd
+
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+OLD = {
+    "mnist": {"round_total_s": 1.0, "miner_crypto_s": 0.9,
+              "final_error": 0.10, "accepted_per_round": 35,
+              "wire_bytes_per_round": 1000.0},
+    "meta": {"nodes": 100, "flags": {"overlay": True}},
+}
+
+
+def test_flatten_numeric_leaves_dotted():
+    flat = bd.flatten(OLD)
+    assert flat["mnist.round_total_s"] == 1.0
+    assert flat["meta.nodes"] == 100
+    assert "meta.flags.overlay" not in flat  # bools are not deltas
+    assert bd.flatten({"a": [1.0, {"b": 2}]}) == {"a.0": 1.0, "a.1.b": 2.0}
+
+
+def test_diff_reports_regressions_and_improvements():
+    new = {
+        "mnist": {"round_total_s": 1.3, "miner_crypto_s": 0.37,
+                  "final_error": 0.10, "accepted_per_round": 35,
+                  "wire_bytes_per_round": 1000.0},
+        "meta": {"nodes": 100},
+        "extra": {"new_key_s": 5.0},
+    }
+    d = bd.diff(bd.flatten(OLD), bd.flatten(new), threshold=0.10)
+    keys = {r["key"]: r for r in d["rows"]}
+    # +30% on a lower-is-better key past the +10% threshold: regression
+    assert keys["mnist.round_total_s"].get("regression")
+    assert [r["key"] for r in d["regressions"]] == ["mnist.round_total_s"]
+    # a large IMPROVEMENT is never a regression
+    assert not keys["mnist.miner_crypto_s"].get("regression")
+    assert d["added"] == ["extra.new_key_s"]
+    assert d["removed"] == ["meta.flags.overlay"] or d["removed"] == []
+    text = bd.format_diff(d)
+    assert "REGRESSION" in text and "round_total_s" in text
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", OLD)
+    same = _write(tmp_path, "same.json", OLD)
+    worse = _write(tmp_path, "worse.json", {
+        "mnist": dict(OLD["mnist"], round_total_s=2.0),
+        "meta": OLD["meta"]})
+    assert bd.main([old, same]) == 0
+    assert bd.main([old, worse, "--threshold", "0.5"]) == 1
+    # threshold above the delta: clean exit
+    assert bd.main([old, worse, "--threshold", "1.5"]) == 0
+    # regression check disabled entirely
+    assert bd.main([old, worse, "--regress", ""]) == 0
+    out = capsys.readouterr().out
+    assert "round_total_s" in out
+
+
+def test_driver_snapshot_tail_unwrap(tmp_path):
+    # the BENCH_r*.json driver snapshots wrap the real table as a JSON
+    # string under `tail`; a parseable tail is unwrapped, a truncated
+    # one falls back to the outer dict
+    wrapped = _write(tmp_path, "w.json",
+                     {"n": 5, "tail": json.dumps(OLD)})
+    assert bd.flatten(bd.load_artifact(wrapped)) == bd.flatten(OLD)
+    truncated = _write(tmp_path, "t.json", {"n": 5, "tail": ".66}, nope"})
+    assert bd.flatten(bd.load_artifact(truncated)) == {"n": 5.0}
+
+
+def test_infinite_pct_on_zero_baseline(tmp_path):
+    d = bd.diff({"a_s": 0.0}, {"a_s": 2.0}, threshold=0.1)
+    row = d["rows"][0]
+    assert row["pct"] == float("inf")
+    # zero baseline cannot regress (no meaningful ratio) but is visible
+    assert not d["regressions"]
+    assert "+inf" in bd.format_diff(d)
+
+
+def test_min_pct_filter_keeps_regressions():
+    d = bd.diff({"x_s": 1.0, "y": 10.0}, {"x_s": 1.2, "y": 10.1},
+                threshold=0.1)
+    text = bd.format_diff(d, min_pct=50.0)
+    assert "x_s" in text  # regression survives the filter
+    assert "\ny " not in text
+
+
+@pytest.mark.parametrize("key,expect", [
+    ("round_total_s", True), ("miner_crypto_s", True),
+    ("wire_bytes_per_round", True), ("final_error", True),
+    ("accepted_per_round", False), ("nodes", False),
+])
+def test_default_regress_pattern_targets_lower_is_better(key, expect):
+    import re
+
+    assert bool(re.search(bd.DEFAULT_REGRESS, key)) is expect
