@@ -310,6 +310,49 @@ def bench_config(name, cfg, device_iters=10, metrics=None):
         recover_s = _timeit(recover, warm=1, iters=reps)
         rec = recover()
         roundtrip_ok = bool(np.allclose(rec, 3 * q / scale, atol=1e-9))
+
+        # --- accelerator-resident crypto (ISSUE 13, --device-crypto):
+        # the SAME mint-time settle with the kernel plane armed
+        # (miner_crypto_device_s), plus the device MSM throughput at
+        # this config's grid width (msm_points_per_s). Gated by
+        # availability and dimensionality: on this bench box the XLA
+        # *CPU* backend emulates the limb kernels, so CNN-sized grids
+        # are priced out by default — raise BISCOTTI_BENCH_DEVICE_MAX_D
+        # on a real accelerator, where the kernels are the point.
+        from biscotti_tpu.crypto import kernels as dk
+
+        device_cap = int(os.environ.get("BISCOTTI_BENCH_DEVICE_MAX_D",
+                                        "2048"))
+        if dk.available() and c_chunks * k <= device_cap:
+            dk.set_enabled(True)
+            try:
+                acc_dev = fold_intake()
+                assert acc_dev.verify(xs_all[sl]), "device settle failed"
+                if acc_dev._acc_dev is None:
+                    # a device fault failed the batch over to CPU
+                    # (VssIntakeBatch._device_failover): recording the
+                    # CPU settle as a device number would be a lie, and
+                    # dk.msm(·, None) would sink the bench — skip the
+                    # device keys for this config, loudly
+                    _progress(f"{name}: device settle failed over to "
+                              f"CPU — device keys skipped")
+                else:
+                    dev_s = _timeit(lambda: acc_dev.verify(xs_all[sl]),
+                                    warm=0, iters=reps)
+                    row["miner_crypto_device_s"] = round(dev_s, 4)
+                    n_pts = c_chunks * k
+                    # RLC-shaped odd ~128-bit scalars (the ladder's cost
+                    # is scalar-width independent; match the lhs shape)
+                    gammas = [((i + 3)
+                               * 0x9E3779B97F4A7C15F39CC0605CEDC835) | 1
+                              for i in range(n_pts)]
+                    msm_t = _timeit(
+                        lambda: dk.msm(gammas, acc_dev._acc_dev),
+                        warm=1, iters=reps)
+                    row["msm_points_per_s"] = round(
+                        n_pts / max(msm_t, 1e-9))
+            finally:
+                dk.set_enabled(False)
         row.update({
             "worker_crypto_s": round(worker_s, 4),
             "miner_intake": intake,
@@ -471,6 +514,55 @@ def bench_peer_density(sizes=(100, 400, 1000), iterations=2,
         except Exception as e:
             out[name] = {"error": f"{type(e).__name__}: {e}"}
             _progress(f"peer_density: N={n} failed: {out[name]['error']}")
+    return out
+
+
+def bench_crypto_kernel(widths=(8, 35, 100)):
+    """Device-crypto microbench (ISSUE 13): CPU vs device MSM across
+    intake widths — the RLC lhs Σγᵢ·Cᵢ shape whose width is the number
+    of commitments a miner batched. Reports per-width seconds and
+    points/s for both paths (device timings are steady-state: one warm
+    call absorbs the per-shape XLA compile), so the BENCH artifact shows
+    device MSM throughput scaling with intake width. The per-config
+    `miner_crypto_device_s` / `msm_points_per_s` keys in the main table
+    carry the same story at each config's full grid dimensionality.
+
+    Set BISCOTTI_BENCH_CRYPTO_KERNEL=0 to skip."""
+    if os.environ.get("BISCOTTI_BENCH_CRYPTO_KERNEL", "1") == "0":
+        return {"skipped": "BISCOTTI_BENCH_CRYPTO_KERNEL=0"}
+    from biscotti_tpu.crypto import commitments as cm
+    from biscotti_tpu.crypto import ed25519 as ed
+    from biscotti_tpu.crypto import kernels as dk
+
+    if not dk.available():
+        return {"skipped": f"device kernels unavailable "
+                           f"({dk.availability_reason()})"}
+    _progress(f"crypto_kernel: CPU vs device MSM at widths {widths}")
+    key = cm.CommitKey.generate(max(widths), label=b"bench-msm")
+    out = {}
+    for w in widths:
+        pts = key.points[:w]
+        scalars = [((i + 3) * 0x9E3779B97F4A7C15F39CC0605CEDC835) | 1
+                   for i in range(w)]
+        # the parity check reuses the timed runs' last results — no
+        # extra MSM just to compare
+        res = {}
+        cpu_s = _timeit(lambda: res.__setitem__("cpu",
+                                                cm.msm(scalars, pts)),
+                        warm=1, iters=3)
+        dev_s = _timeit(lambda: res.__setitem__("dev",
+                                                dk.msm(scalars, pts)),
+                        warm=1, iters=3)
+        ok = ed.point_equal(res["cpu"], res["dev"])
+        out[f"w{w}"] = {
+            "cpu_msm_s": round(cpu_s, 5),
+            "device_msm_s": round(dev_s, 5),
+            "cpu_msm_points_per_s": round(w / max(cpu_s, 1e-9)),
+            "device_msm_points_per_s": round(w / max(dev_s, 1e-9)),
+            "results_equal": bool(ok),
+        }
+        _progress(f"crypto_kernel: w={w} cpu {cpu_s:.4f}s "
+                  f"device {dev_s:.4f}s equal={bool(ok)}")
     return out
 
 
@@ -671,6 +763,21 @@ def main():
     # 0/10/20% slowed peers, fixed vs adaptive deadlines
     straggler = bench_straggler_degradation()
 
+    # device-crypto microbench (ISSUE 13): CPU vs device MSM across
+    # intake widths {8, 35, 100} — the scaling evidence for the
+    # accelerator-resident crypto plane
+    crypto_kernel = bench_crypto_kernel()
+    if registry is not None and isinstance(crypto_kernel, dict):
+        msm_gauge = registry.gauge(
+            "biscotti_bench_msm_points_per_s",
+            "bench MSM throughput by path across intake widths")
+        for wname, r in crypto_kernel.items():
+            if isinstance(r, dict) and "cpu_msm_points_per_s" in r:
+                msm_gauge.set(r["cpu_msm_points_per_s"], width=wname,
+                              path="cpu")
+                msm_gauge.set(r["device_msm_points_per_s"], width=wname,
+                              path="device")
+
     detail = {
         "device": str(jax.devices()[0]),
         "data_note": ("synthetic Gaussian shards at reference dimensions "
@@ -679,6 +786,7 @@ def main():
         "configs": rows,
         "peer_density": density,
         "straggler_degradation": straggler,
+        "crypto_kernel": crypto_kernel,
     }
     # Full per-config detail goes to a file + stderr; stdout carries exactly
     # ONE compact JSON line so the driver's parser always succeeds
@@ -724,6 +832,10 @@ def main():
         # profile, fixed vs adaptive deadlines — the robustness number
         # the straggler-tolerance plane exists to move
         "straggler_degradation": straggler,
+        # device-crypto microbench (crypto/kernels): CPU vs device MSM
+        # across intake widths — the scaling evidence behind
+        # --device-crypto (docs/CRYPTO_KERNELS.md)
+        "crypto_kernel": crypto_kernel,
     }
     print(json.dumps(out))
     return 0

@@ -280,6 +280,20 @@ class BiscottiConfig:
     # --peers-per-host. Required >= 2 when overlay is on.
     overlay_group: int = 0
 
+    # --- accelerator-resident crypto plane (crypto/kernels,
+    # docs/CRYPTO_KERNELS.md) ---
+    # device_crypto=True arms the limb-decomposed Ed25519/Pedersen
+    # kernels: the batched miner-crypto seams (RLC commitment batches,
+    # VSS intake wave folds + settle, Schnorr quorum batches, Shamir
+    # recovery) compute their verdicts on the accelerator instead of as
+    # CPU bigint work. The CPU path remains the exact-verdict oracle:
+    # every rejection (bisection, per-worker fallback) and therefore
+    # every stake debit still comes from the CPU recompute, and the
+    # plane degrades loudly-but-gracefully to CPU when jax/x64 is
+    # unavailable. Default OFF = today's CPU path bit-identical
+    # (guarded by tests/test_crypto_kernels.py).
+    device_crypto: bool = False
+
     # --- wire data plane (runtime/codecs.py, docs/WIRE_PLANE.md) ---
     # negotiated payload codec for protocol traffic: "raw64" (legacy
     # float64 frames, the default), "f32"/"bf16" (downcast — applied to
@@ -706,6 +720,14 @@ class BiscottiConfig:
                        default=BiscottiConfig.overlay_group,
                        help="peers per overlay subtree (contiguous ids; "
                             "match --peers-per-host on a hive fleet)")
+        p.add_argument("--device-crypto", type=int,
+                       default=int(BiscottiConfig.device_crypto),
+                       help="1 arms the accelerator-resident crypto "
+                            "plane: batched miner crypto (RLC commitment "
+                            "batches, VSS intake folds, Schnorr quorums, "
+                            "Shamir recovery) runs as limb-decomposed "
+                            "device kernels; 0 = the CPU path, "
+                            "bit-identical (docs/CRYPTO_KERNELS.md)")
         p.add_argument("--wire-codec", type=str,
                        default=BiscottiConfig.wire_codec,
                        help="payload codec for protocol traffic "
@@ -788,6 +810,8 @@ class BiscottiConfig:
             pipeline_depth=getattr(ns, "pipeline_depth", cls.pipeline_depth),
             speculation=bool(getattr(ns, "speculation", cls.speculation)),
             batch_intake=bool(getattr(ns, "batch_intake", cls.batch_intake)),
+            device_crypto=bool(getattr(ns, "device_crypto",
+                                       cls.device_crypto)),
             overlay=bool(getattr(ns, "overlay", cls.overlay)),
             overlay_group=getattr(ns, "overlay_group", cls.overlay_group),
             wire_codec=getattr(ns, "wire_codec", cls.wire_codec),

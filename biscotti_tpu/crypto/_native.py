@@ -21,6 +21,30 @@ _LIB_PATHS = [
 
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
+# why the native plane is unavailable ("" while loaded / not yet probed):
+# surfaced ONCE on stderr at load time — the pure-Python fallback keeps
+# every caller correct (parity-tested), but silently eating a ~30x miner
+# crypto slowdown deep inside a round was the old failure mode
+_load_error = ""
+
+
+def load_error() -> str:
+    """Human-readable reason the native library is unavailable, or ""
+    when it loaded (or was never needed). Probes the loader."""
+    _load()
+    return _load_error
+
+
+def _degrade(reason: str) -> None:
+    """Record and announce the pure-Python degradation, once."""
+    global _load_error
+    _load_error = reason
+    import sys
+
+    print(f"[crypto/_native] native EC backend unavailable: {reason} — "
+          f"falling back to the pure-Python path (correct, parity-tested, "
+          f"~30x slower miner crypto). Build the `libbiscotti_native.so` "
+          f"target with `make -C native` to restore it.", file=sys.stderr)
 
 
 def _build() -> None:
@@ -60,7 +84,10 @@ def _selfcheck(lib: ctypes.CDLL) -> bool:
     return ed.point_equal(point_from_xy64(out.raw), expect)
 
 
-def _try_load(full: str) -> Optional[ctypes.CDLL]:
+def _try_load(full: str) -> Tuple[Optional[ctypes.CDLL], str]:
+    """(loaded library, "") or (None, reason). AttributeError means the
+    binary's exported symbols predate the sources — an ABI-stale .so —
+    which gets its own actionable message."""
     try:
         lib = ctypes.CDLL(full)
         lib.ed25519_msm.restype = ctypes.c_int
@@ -130,10 +157,14 @@ def _try_load(full: str) -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
         ]
         if not _selfcheck(lib):
-            return None
-        return lib
-    except (OSError, AttributeError):
-        return None
+            return None, (f"{full} failed the cross-backend self-check "
+                          "(stale or tampered binary)")
+        return lib, ""
+    except AttributeError as e:
+        return None, (f"{full} is ABI-stale — exported symbols predate "
+                      f"the sources ({e})")
+    except OSError as e:
+        return None, f"{full} failed to load ({e})"
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -145,17 +176,24 @@ def _load() -> Optional[ctypes.CDLL]:
     # refreshes a stale binary whose exported symbols predate the sources
     # (which would otherwise silently drop all native acceleration)
     _build()
+    reason = ""
+    found = False
     for path in _LIB_PATHS:
         full = os.path.abspath(path)
         if not os.path.exists(full):
             continue
-        lib = _try_load(full)
+        found = True
+        lib, reason = _try_load(full)
         if lib is None:
             _build()  # one retry in case the first build raced/failed
-            lib = _try_load(full)
+            lib, reason = _try_load(full)
         if lib is not None:
             _lib = lib
             break
+    if _lib is None:
+        _degrade(reason if found else
+                 "native/libbiscotti_native.so not found (never built, "
+                 "or BISCOTTI_NO_NATIVE_BUILD=1 suppressed the build)")
     return _lib
 
 

@@ -71,6 +71,22 @@ def msm(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     return _msm_python(scalars, points)
 
 
+def _device_mod():
+    """The accelerator-resident kernel plane (crypto/kernels,
+    docs/CRYPTO_KERNELS.md) when ARMED (--device-crypto) and runnable —
+    None otherwise. Consulted only at the batched seams below: device
+    verdicts are computed from the identical group equations, and every
+    REJECTION still routes through the CPU recompute/bisection paths, so
+    rejection evidence and stake debits stay byte-identical to the CPU
+    configuration."""
+    try:
+        from biscotti_tpu.crypto import kernels
+
+        return kernels.active_module()
+    except ImportError:
+        return None
+
+
 def _msm_python(scalars: Sequence[int], points: Sequence[ed.Point]) -> ed.Point:
     if len(scalars) != len(points):
         raise ValueError("scalar/point length mismatch")
@@ -130,6 +146,9 @@ class CommitKey:
     # ~2.4 s/update at d=7,850 — 30× the MSM itself; a keyed miner
     # recomputing its whole intake rode the 90 s round deadline on it)
     _native_buf: Optional[bytes] = None
+    # lazily-built device limb buffer ([d, 4, 16] int64 extended limbs)
+    # for the --device-crypto MSM path — same build-once rationale
+    _device_buf: Optional[object] = None
 
     # derivation/deserialization memo: the generator ladder is a pure
     # function of (dims, label) and the `_hash_to_point` try-and-increment
@@ -205,6 +224,17 @@ class CommitKey:
                 + (t % ed.P).to_bytes(32, "little")
                 for x, y, z, t in self.points))
         return self._native_buf[: 128 * n]
+
+    def device_buf(self, n: int):
+        """First n points as the device kernel plane's [n, 4, 16] limb
+        batch (crypto/kernels); built once per key like native_buf."""
+        if self._device_buf is None or len(self._device_buf) < n:
+            from biscotti_tpu.crypto.kernels import group as _gp
+
+            object.__setattr__(
+                self, "_device_buf",
+                _gp.points_to_limbs(self.points).astype("int64"))
+        return self._device_buf[:n]
 
 
 def commit_update(q: np.ndarray, key: CommitKey) -> bytes:
@@ -326,6 +356,22 @@ def batch_verify_commitments(items: Sequence[Tuple[bytes, np.ndarray]],
         for g, row in zip(gam, qmat):
             accobj += g * row.astype(object)
         scalars = [int(v) for v in accobj]
+    dev = _device_mod()
+    if dev is not None:
+        # device verdict: same two group equations on the accelerator
+        # (RLC lhs over the intake's commitments, combined-scalar rhs
+        # over the commit key's limb buffer). Integer limb arithmetic is
+        # exact, so the computed group elements — and the verdict — are
+        # identical to the CPU backends'; a failed batch still bisects
+        # through the CPU recompute (find_bad_commitments), so rejection
+        # evidence never comes from this path. Any device fault falls
+        # back to the CPU verdict below.
+        try:
+            lhs = dev.msm(gam, c_pts)
+            rhs = dev.msm(scalars, key.device_buf(d))
+            return ed.point_equal(lhs, rhs)
+        except Exception:
+            pass
     lhs = msm(gam, c_pts)
     if native is not None:
         rhs = native.msm_raw(scalars, key.native_buf(d), d)
@@ -468,6 +514,19 @@ def batch_schnorr_verify(items: Sequence[Tuple[bytes, bytes, bytes]]) -> bool:
         points.append(_clear8(r_pt))
         scalars.append((g * c) % _Q)
         points.append(y_pt)
+    dev = _device_mod()
+    if dev is not None:
+        # device verdict over the identical cofactored equation; every
+        # point in the MSM is already torsion-cleared (8R / 8Y), so the
+        # device and CPU backends compute the same group elements. A
+        # False verdict still falls back per-item in the caller — the
+        # rejection evidence path is untouched.
+        try:
+            lhs = dev.fixed_base_mult([s_tot % _Q])[0]
+            rhs = dev.msm(scalars, points)
+            return ed.point_equal(lhs, rhs)
+        except Exception:
+            pass
     lhs = base_mult_fast(s_tot % _Q)
     rhs = msm(scalars, points)
     return ed.point_equal(lhs, rhs)
@@ -723,6 +782,30 @@ def batch_pedersen_commit_xy(a: Sequence[int], b: Sequence[int]) -> bytes:
     return bytes(out)
 
 
+def _rlc_coeffs(xs: Sequence[int], gam_bytes: bytes, c_chunks: int,
+                k: int) -> List[int]:
+    """The python RLC verification-coefficient chain shared by every
+    batched-VSS settle path (one-shot fallback, accumulator python
+    settle, accumulator device settle): coeff[ci·k + j] = Σ over cells
+    (r, ci) of γ_cell·x_rʲ, accumulated over plain signed ints with one
+    caller-side mod-q reduction (|x| ≤ S keeps γ·xʲ short). ONE copy —
+    the device/CPU verdict-parity contract depends on these chains never
+    drifting apart."""
+    coeff = [0] * (c_chunks * k)
+    cell = 0
+    for r, x in enumerate(xs):
+        xi = int(x)
+        for ci in range(c_chunks):
+            xj = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
+                                "little")
+            cell += 1
+            base = ci * k
+            for j in range(k):
+                coeff[base + j] += xj
+                xj *= xi
+    return coeff
+
+
 def _xy_to_point(buf: bytes) -> Optional[ed.Point]:
     """Parse + validate one 64B affine pair (python fallback for the native
     batch loader): canonical coords and on-curve, subgroup NOT checked."""
@@ -957,18 +1040,7 @@ def vss_verify_multi(instances: Sequence[Tuple[np.ndarray, Sequence[int],
                 return False
             sum_bufs.append(buf)
         else:
-            coeff = [0] * (c_chunks * k)
-            cell = 0
-            for r, x in enumerate(xs):
-                xi = int(x)
-                for ci in range(c_chunks):
-                    xj = int.from_bytes(gam_bytes[16 * cell: 16 * (cell + 1)],
-                                        "little")
-                    cell += 1
-                    base = ci * k
-                    for j in range(k):
-                        coeff[base + j] += xj
-                        xj *= xi
+            coeff = _rlc_coeffs(xs, gam_bytes, c_chunks, k)
             all_scalars.extend((8 * v) % _Q for v in coeff)
             summed = loaded[0]
             for pts in loaded[1:]:
@@ -1204,6 +1276,15 @@ class VssIntakeBatch:
         self._pending: List[int] = []  # sids booked but not yet folded
         self._acc: Optional[bytearray] = None  # native 128B/pt extended
         self._acc_py: Optional[List[ed.Point]] = None  # python fallback
+        # device limb accumulator ([n, 4, 16] int64) — the --device-crypto
+        # wave-fold path. The arming switch is sampled per fold, so one
+        # accumulator object must live entirely on one side; the runtime
+        # arms the plane at construction and never flips it mid-round.
+        # A device FAULT (not a False verdict) sets _dev_failed and
+        # rebuilds the CPU accumulator from the retained member grids —
+        # the batch finishes on the CPU path instead of failing the round.
+        self._acc_dev = None
+        self._dev_failed = False
 
     def __len__(self) -> int:
         return len(self._members)
@@ -1266,6 +1347,19 @@ class VssIntakeBatch:
         self._t_tot -= t_add
         self._members.pop(sid, None)
 
+    def _device_failover(self) -> List[int]:
+        """A device kernel FAULTED mid-batch (backend OOM, compile
+        failure — never a verdict): retire the device accumulator for
+        this batch's lifetime and rebuild the CPU accumulator by
+        re-folding every retained member grid (earlier waves live only
+        in the device accumulator, and the grids are all retained in
+        self._members). Returns the sids that need re-folding."""
+        self._dev_failed = True
+        self._acc_dev = None
+        self._acc = None
+        self._acc_py = None
+        return [sid for sid in self._members if sid not in self._pending]
+
     def fold(self) -> List[int]:
         """Fold the pending wave of grids into the point accumulator:
         one vectorized validate+sum over the wave (load_xy_sum_ptrs,
@@ -1279,6 +1373,31 @@ class VssIntakeBatch:
         rejected: List[int] = []
         native = _native_mod()
         n = self.c * self.k
+        dev = None if self._dev_failed else _device_mod()
+        if dev is not None:
+            # device wave fold: one all-or-nothing canonicity + on-curve
+            # validation over the whole wave (grid_validate_sum, the
+            # ed25519_xy_accum equivalent) with a per-grid verdict mask —
+            # the same cells the CPU loaders reject, so the evicted sid
+            # set is identical — then one pointwise tree sum folded into
+            # the limb accumulator. A device FAULT rebuilds the CPU
+            # accumulator from every retained grid and this batch
+            # continues on the CPU path (verdicts unchanged either way).
+            try:
+                grids = [self._members[sid][0] for sid in wave]
+                mask, summed = dev.grid_validate_sum(grids)
+                for sid, ok in zip(wave, mask):
+                    if not ok:
+                        self._evict(sid)
+                        rejected.append(sid)
+                if summed is not None:
+                    self._acc_dev = (summed if self._acc_dev is None
+                                     else dev.ext_add(self._acc_dev,
+                                                      summed))
+                return rejected
+            except Exception:
+                wave = self._device_failover()
+                rejected = []
         if native is not None:
             grids = [self._members[sid][0] for sid in wave]
             if len(wave) == 1 and self._acc is not None:
@@ -1345,6 +1464,27 @@ class VssIntakeBatch:
         if len(xs) != self.rows:
             return False
         native = _native_mod()
+        dev = _device_mod()
+        if dev is not None and self._acc_dev is not None:
+            # device settle: the RLC scalar chain stays host-side (the
+            # shared _rlc_coeffs helper), the C·k-point MSM and the
+            # s·G + t·H comb run on the accelerator over the wave-folded
+            # limb accumulator. Identical group equation ⇒ identical
+            # verdict; a False here still falls back to the exact
+            # per-member CPU checks in the caller, and a device FAULT
+            # rebuilds the CPU accumulator from the retained grids and
+            # settles there.
+            try:
+                coeff = _rlc_coeffs(xs, self._gam, self.c, self.k)
+                rhs = dev.msm([(8 * v) % _Q for v in coeff], self._acc_dev)
+                lhs = dev.pedersen_commit_point((8 * self._s_tot) % _Q,
+                                                (8 * self._t_tot) % _Q)
+                return ed.point_equal(lhs, rhs)
+            except Exception:
+                # re-fold every retained grid through the CPU path, then
+                # settle below exactly as an all-CPU batch would
+                self._pending = self._device_failover()
+                self.fold()
         if native is not None and self._acc is not None:
             sb, sgn = native.vss_rlc_scalars(
                 [int(x) for x in xs], self._gam, self.c, self.k)
@@ -1352,18 +1492,7 @@ class VssIntakeBatch:
             lhs: ed.Point = native.point_from_xy64(native.batch_commit_xy(
                 [(8 * self._s_tot) % _Q], [(8 * self._t_tot) % _Q]))
         else:
-            coeff = [0] * (self.c * self.k)
-            cell = 0
-            for r, x in enumerate(xs):
-                xi = int(x)
-                for ci in range(self.c):
-                    xj = int.from_bytes(self._gam[16 * cell: 16 * (cell + 1)],
-                                        "little")
-                    cell += 1
-                    base = ci * self.k
-                    for j in range(self.k):
-                        coeff[base + j] += xj
-                        xj *= xi
+            coeff = _rlc_coeffs(xs, self._gam, self.c, self.k)
             assert self._acc_py is not None
             rhs = msm([(8 * v) % _Q for v in coeff], self._acc_py)
             lhs = ed.point_add(ed.base_mult((8 * self._s_tot) % _Q),

@@ -1,0 +1,111 @@
+"""Accelerator-resident crypto plane (ISSUE 13, ROADMAP open item #2).
+
+After PR 6's batching, miner crypto is one big multi-scalar
+multiplication per intake — CPU bigint work while the device idles. This
+package moves the four hot kernels onto the accelerator as limb-
+decomposed vmapped jnp programs (`field.py` → `group.py` → `msm.py`),
+behind one process-wide arming switch:
+
+    from biscotti_tpu.crypto import kernels
+    kernels.set_enabled(True)          # what --device-crypto does
+    kernels.active()                   # armed AND runnable here
+
+**Default OFF.** Disarmed (or unavailable: no jax, x64 mode off), every
+caller takes today's CPU path bit-identically. Armed, the seams PR 6
+created — `cm.batch_verify_commitments`, `VssIntakeBatch` wave folds,
+`cm.batch_schnorr_verify`, `ss.recover_coeffs` — compute their batch
+verdicts on device; the CPU path stays the exact-verdict oracle, and
+REJECTION evidence (bisection, per-worker fallback, stake debits) always
+comes from the CPU recompute, so debits stay byte-identical
+(docs/CRYPTO_KERNELS.md spells out the contract; the property suite in
+tests/test_crypto_kernels.py pins every kernel against the python-int
+oracles).
+
+Importing this package is cheap (numpy only): jax loads lazily on first
+`available()` / kernel call, so the disarmed runtime never pays for it.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+from biscotti_tpu.crypto.kernels.instrument import (  # noqa: F401
+    device_calls, device_seconds, release_hooks, reset_counters,
+    set_metrics_registry, set_span_hook)
+from biscotti_tpu.crypto.kernels.primitives import (  # noqa: F401
+    ext_add, fixed_base_mult, grid_validate_sum, msm, pedersen_commit_point,
+    point_neg_limbs, prewarm, shamir_recover)
+
+_enabled = False
+_avail: Optional[bool] = None
+_avail_reason = ""
+_warned = False
+
+
+def set_enabled(on: bool) -> None:
+    """Arm/disarm the device-crypto plane process-wide (the
+    --device-crypto switch). Arming while unavailable degrades loudly —
+    one stderr note naming why — but gracefully: every seam keeps its
+    CPU path."""
+    global _enabled, _warned
+    _enabled = bool(on)
+    if _enabled and not available() and not _warned:
+        _warned = True
+        print(f"[crypto/kernels] --device-crypto requested but the device "
+              f"plane is unavailable ({_avail_reason}); all crypto stays "
+              f"on the CPU path", file=sys.stderr)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def available() -> bool:
+    """True when the kernel plane can run here: jax imports and x64 mode
+    is on (the limb accumulators are int64; enable via JAX_ENABLE_X64=1
+    or jax.config.update('jax_enable_x64', True) before first use)."""
+    global _avail, _avail_reason
+    if _avail is None:
+        try:
+            import jax
+
+            if not jax.config.jax_enable_x64:
+                _avail = False
+                _avail_reason = ("jax x64 mode disabled — int64 limb "
+                                 "accumulators need JAX_ENABLE_X64=1")
+            else:
+                jax.devices()
+                _avail = True
+        except Exception as e:  # pragma: no cover - env-dependent
+            _avail = False
+            _avail_reason = f"jax unavailable: {type(e).__name__}: {e}"
+    return bool(_avail)
+
+
+def availability_reason() -> str:
+    available()
+    return _avail_reason
+
+
+def active() -> bool:
+    """Armed AND runnable — the one predicate every CPU/device dispatch
+    seam consults."""
+    return _enabled and available()
+
+
+def active_module():
+    """This package when `active()`, else None — the shared body of the
+    per-seam `_device_mod()` probes (commitments.py, secretshare.py), so
+    the dispatch predicate lives in exactly one place."""
+    import biscotti_tpu.crypto.kernels as _k
+
+    return _k if active() else None
+
+
+def _reset_probe_for_tests() -> None:
+    """Forget the cached availability probe (tests flip x64/jax state)."""
+    global _avail, _avail_reason, _warned
+    _avail = None
+    _avail_reason = ""
+    _warned = False

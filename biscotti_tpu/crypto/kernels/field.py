@@ -1,0 +1,252 @@
+"""Limb-decomposed Ed25519 base-field arithmetic as pure jnp ops.
+
+The accelerator has no bigint datapath, so field elements of
+GF(p), p = 2²⁵⁵ − 19 are carried as **16 radix-2¹⁶ limbs** (stored int32
+on the wire buffers, widened to int64 inside the kernels) with *lazy*
+carries: ops keep limbs inside a loose `< 2¹⁷` invariant instead of
+canonicalizing after every step, so a field multiply is one outer-product
++ one constant [256, 31] convolution matmul (MXU-shaped) + two short
+carry chains. The loose invariant is what makes the bounds work:
+
+    inputs  < 2¹⁷ per limb
+    products < 2³⁴, convolution sum of ≤ 16 terms < 2³⁸
+    2²⁵⁶ ≡ 38 fold:  lo + 38·hi < 2³⁸·39 < 2⁴⁴  — comfortably int64
+    two carry passes → every limb back under 2¹⁷
+
+Hot-path carries are PARALLEL carry-save passes (4 vector ops, no
+16-step chain — see `carry`); only the canonical representative pays
+for exact sequential propagation (`carry_seq`). Subtraction adds a
+limb-wise 8p constant (representable in 16 *non-normalized* limbs, each
+≥ 2¹⁸ > any loose limb) so intermediate limbs never need
+signed-magnitude handling beyond the carry passes' arithmetic shifts.
+Exact canonical form (for equality / on-curve verdicts) is four
+sequential carry passes + two conditional subtractions of p — value
+< 2²⁵⁶ < 2p + 38 makes two enough.
+
+Everything here is shape-polymorphic over leading batch dimensions
+([..., 16] limb tensors), so the group layer vmaps for free. Host-side
+packing helpers (python ints / RFC-8032 byte strings ↔ limb arrays) are
+numpy, zero python-bigint work per element beyond `int.to_bytes`.
+
+Oracle: `crypto/ed25519.py` python ints — every op here is property-
+tested bit-equal against it (tests/test_crypto_kernels.py, including the
+carry-overflow edges 0, 1, p−1, q−1, all-limbs-0xFFFF).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from biscotti_tpu.crypto import ed25519 as ed
+
+LIMBS = 16
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+
+P = ed.P
+Q = ed.Q
+
+# 2²⁵⁶ mod p = 38 — the high-half fold constant
+FOLD = 38
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    """One canonical field element → (16,) int32 limb vector."""
+    b = (int(v) % P).to_bytes(32, "little")
+    return np.frombuffer(b, dtype="<u2").astype(np.int32)
+
+
+def ints_to_limbs(vals: Sequence[int]) -> np.ndarray:
+    """[n] canonical field elements → [n, 16] int32 limbs (one bytes
+    join, no per-limb python arithmetic)."""
+    blob = b"".join((int(v) % P).to_bytes(32, "little") for v in vals)
+    return (np.frombuffer(blob, dtype="<u2")
+            .reshape(len(vals), LIMBS).astype(np.int32))
+
+
+def limbs_to_int(arr) -> int:
+    """(…,16) limb vector (any non-negative magnitudes) → python int.
+    NOT reduced mod p — callers reduce when they need the field value."""
+    a = np.asarray(arr, dtype=object).reshape(-1)
+    return sum(int(a[i]) << (LIMB_BITS * i) for i in range(len(a)))
+
+
+def bytes_to_limbs(buf: bytes, n: int) -> np.ndarray:
+    """n packed 32-byte little-endian values → [n, 16] int32 limbs.
+    No canonicity check — feed the result to `lt_p` for that."""
+    if len(buf) != 32 * n:
+        raise ValueError("buffer length mismatch")
+    return (np.frombuffer(buf, dtype="<u2")
+            .reshape(n, LIMBS).astype(np.int32))
+
+
+# constant limb tables (numpy; jnp closes over them as constants).
+# P itself must bypass int_to_limbs — that helper canonicalizes mod p,
+# which would turn the modulus into the zero vector.
+P_LIMBS = np.frombuffer(P.to_bytes(32, "little"),
+                        dtype="<u2").astype(np.int64)
+# 8p as 16 NON-NORMALIZED limbs: 4 × (2²⁵⁶ − 38) limb-wise. Every limb is
+# ≥ 2¹⁸ − 152 > 2¹⁷, so `a + EIGHT_P - b` never goes negative under the
+# loose < 2¹⁷ limb invariant.
+EIGHT_P = (np.array([0xFFFF - 37] + [0xFFFF] * 15, dtype=np.int64) * 4)
+D_LIMBS = int_to_limbs(ed.D).astype(np.int64)
+D2_LIMBS = int_to_limbs(2 * ed.D % P).astype(np.int64)
+ONE_LIMBS = int_to_limbs(1).astype(np.int64)
+ZERO_LIMBS = np.zeros(LIMBS, dtype=np.int64)
+
+
+def _conv_matrix() -> np.ndarray:
+    """[256, 31] 0/1 matrix routing the 16×16 outer products to their
+    convolution diagonals — the field multiply becomes one matmul."""
+    m = np.zeros((LIMBS * LIMBS, 2 * LIMBS - 1), dtype=np.int64)
+    for i in range(LIMBS):
+        for j in range(LIMBS):
+            m[i * LIMBS + j, i + j] = 1
+    return m
+
+
+CONV = _conv_matrix()
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def carry(x, passes: int = 2):
+    """PARALLEL (carry-save) lazy-carry passes with the 2²⁵⁶ ≡ 38 top
+    fold: every pass is four vector ops (split, mask, rotate-with-fold,
+    add) with NO sequential limb chain — the hot-ladder form. A pass
+    moves each carry one limb; it does NOT fully propagate, which the
+    loose `< 2¹⁷` invariant tolerates:
+
+        post-multiply v < 2⁴⁴  → pass 1 carries < 2²⁸, limbs < 2¹⁶+2²⁸
+                               → pass 2 carries < 2¹³, limbs < 2¹⁶+2¹³ ✓
+        post-add/sub  v < 2¹⁹  → one pass leaves limbs < 2¹⁶+2⁹ ✓
+
+    Arithmetic shifts + two's-complement masking keep the pass exact for
+    the ≥ −2¹⁶ limbs subtraction can transiently produce. Exact
+    propagation (canonical form) is `carry_seq`'s job."""
+    jnp = _jnp()
+    for _ in range(passes):
+        c = x >> LIMB_BITS
+        rot = jnp.concatenate([FOLD * c[..., LIMBS - 1:],
+                               c[..., :LIMBS - 1]], axis=-1)
+        x = (x & MASK) + rot
+    return x
+
+
+def carry_seq(x, passes: int = 2):
+    """Sequential full-propagation carry chains (the slow exact form the
+    canonical representative needs). Arithmetic shifts make the chain
+    correct for (slightly) negative limbs too."""
+    jnp = _jnp()
+    for _ in range(passes):
+        out = []
+        c = jnp.zeros_like(x[..., 0])
+        for i in range(LIMBS):
+            v = x[..., i] + c
+            c = v >> LIMB_BITS
+            out.append(v & MASK)
+        x = jnp.stack(out, axis=-1)
+        x = x.at[..., 0].add(FOLD * c)
+    return x
+
+
+def fmul(a, b):
+    """Field multiply of two loose (< 2¹⁷ limbs) elements; returns a
+    loose element. One outer product + the CONV matmul + fold + carries."""
+    jnp = _jnp()
+    prod = a[..., :, None] * b[..., None, :]  # [..., 16, 16] < 2^34
+    conv = prod.reshape(*prod.shape[:-2], LIMBS * LIMBS) @ CONV  # [..., 31]
+    lo = conv[..., :LIMBS]
+    hi = jnp.concatenate(
+        [conv[..., LIMBS:],
+         jnp.zeros_like(conv[..., :1])], axis=-1)  # pad position 31
+    return carry(lo + FOLD * hi, passes=2)
+
+
+def fadd(a, b):
+    return carry(a + b, passes=1)
+
+
+def fsub(a, b):
+    """a − b mod p via the non-normalized 8p limb constant (keeps every
+    intermediate limb non-negative under the loose invariant)."""
+    return carry(a + EIGHT_P - b, passes=1)
+
+
+def _cond_sub_p(x):
+    """One conditional canonical-form subtraction: x − p when x ≥ p.
+    Requires properly carried limbs (< 2¹⁶)."""
+    jnp = _jnp()
+    outs = []
+    borrow = jnp.zeros_like(x[..., 0])
+    for i in range(LIMBS):
+        v = x[..., i] - int(P_LIMBS[i]) - borrow
+        borrow = (v < 0).astype(v.dtype)
+        outs.append(v + (borrow << LIMB_BITS))
+    sub = jnp.stack(outs, axis=-1)
+    keep = (borrow > 0)[..., None]  # final borrow → x < p → keep x
+    return jnp.where(keep, x, sub)
+
+
+def canonical(x):
+    """Exact canonical representative (< p, limbs < 2¹⁶) of a loose
+    element — the form equality and on-curve verdicts compare. Four
+    sequential passes: three settle the loose magnitudes, the fourth
+    retires the ≤ 38 residue the top fold can leave on limb 0, so
+    `_cond_sub_p`'s borrow logic always sees properly carried limbs."""
+    x = carry_seq(x, passes=4)
+    x = _cond_sub_p(x)
+    return _cond_sub_p(x)
+
+
+def is_zero(x):
+    """True where the loose element ≡ 0 mod p. Returns a boolean with
+    the input's batch shape."""
+    jnp = _jnp()
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(a, b):
+    jnp = _jnp()
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def lt_p(x):
+    """Canonicity test for *carried* (< 2¹⁶ limbs) values: strict x < p,
+    matching the pure-python loaders' rejection of non-canonical wire
+    coordinates."""
+    jnp = _jnp()
+    lt = jnp.zeros(x.shape[:-1], dtype=bool)
+    eq_so_far = jnp.ones(x.shape[:-1], dtype=bool)
+    for i in range(LIMBS - 1, -1, -1):
+        pi = int(P_LIMBS[i])
+        lt = lt | (eq_so_far & (x[..., i] < pi))
+        eq_so_far = eq_so_far & (x[..., i] == pi)
+    return lt
+
+
+def scalars_to_bits(scalars: Sequence[int], bits: int = 256,
+                    msb_first: bool = True) -> np.ndarray:
+    """[n] non-negative ints (< 2^bits) → [n, bits] uint8 bit matrix.
+    MSB-first is the double-and-add order; LSB-first feeds the fixed-base
+    table walk."""
+    n = len(scalars)
+    blob = b"".join(int(s).to_bytes(bits // 8, "little") for s in scalars)
+    by = np.frombuffer(blob, dtype=np.uint8).reshape(n, bits // 8)
+    b = np.unpackbits(by, axis=1, bitorder="little")  # [n, bits] LSB-first
+    return b[:, ::-1].copy() if msb_first else b
+
+
+__all__: List[str] = [
+    "LIMBS", "LIMB_BITS", "MASK", "P", "Q", "CONV",
+    "int_to_limbs", "ints_to_limbs", "limbs_to_int", "bytes_to_limbs",
+    "P_LIMBS", "EIGHT_P", "D_LIMBS", "D2_LIMBS", "ONE_LIMBS", "ZERO_LIMBS",
+    "carry", "carry_seq", "fmul", "fadd", "fsub", "canonical", "is_zero", "eq", "lt_p",
+    "scalars_to_bits",
+]

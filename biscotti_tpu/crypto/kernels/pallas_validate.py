@@ -1,0 +1,93 @@
+"""Experimental Pallas on-curve validation kernel.
+
+The commitment-grid check is the one device-crypto kernel that is pure
+element-wise limb arithmetic (no cross-lane reduction until the final
+all()), i.e. the VPU-shaped candidate the ISSUE's "where profitable,
+Pallas" clause names. This kernel computes the curve residual
+y² − x² − 1 − d·x²y² per cell over a (TILE, 2, 16) limb block and emits
+the per-cell zero-residual mask. The limb constants (the convolution
+routing matrix, the 8p subtraction bias, the curve d) ride in as kernel
+inputs — Pallas kernels cannot close over traced constants — while the
+carry chains and canonical-form logic reuse `kernels.field` directly
+(those touch python-int scalars only).
+
+Status: **experimental, off by default** (BISCOTTI_PALLAS_CRYPTO=1 opts
+in; `primitives.grid_validate_sum` then cross-checks it against the XLA
+verdict and fails loudly on disagreement — the two paths must never
+split a consensus verdict). Off-TPU it runs in interpret mode, the same
+pattern `ops/krum_pallas.py` uses; on TPU hardware the int64 limb
+algebra would need the 8-bit-limb re-tiling documented in
+docs/CRYPTO_KERNELS.md before Mosaic accepts it, which is why the XLA
+conv-matmul path — which already lowers to MXU-shaped ops — remains the
+shipping default.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from biscotti_tpu.crypto.kernels import field as fe
+
+TILE = 128
+
+
+def _kernel(xy_ref, conv_ref, eightp_ref, d_ref, out_ref):
+    import jax.numpy as jnp
+
+    x = xy_ref[:, 0, :]
+    y = xy_ref[:, 1, :]
+    conv = conv_ref[...]
+    eightp = eightp_ref[...]
+    d_limbs = jnp.broadcast_to(d_ref[...][None, :], x.shape)
+
+    def fmul(a, b):
+        prod = a[:, :, None] * b[:, None, :]
+        c = prod.reshape(a.shape[0], fe.LIMBS * fe.LIMBS) @ conv
+        lo = c[:, :fe.LIMBS]
+        hi = jnp.concatenate([c[:, fe.LIMBS:], jnp.zeros_like(c[:, :1])],
+                             axis=1)
+        return fe.carry(lo + 38 * hi, passes=2)
+
+    def fsub(a, b):
+        return fe.carry(a + eightp[None, :] - b, passes=1)
+
+    xx = fmul(x, x)
+    yy = fmul(y, y)
+    lhs = fsub(yy, xx)
+    one = jnp.zeros_like(x).at[:, 0].set(1)
+    rhs = fe.carry(one + fmul(d_limbs, fmul(xx, yy)), passes=1)
+    ok = jnp.all(fe.canonical(lhs) == fe.canonical(rhs), axis=-1)
+    out_ref[:] = ok.astype(jnp.int32)[:, None]
+
+
+def oncurve_mask(xy: np.ndarray) -> np.ndarray:
+    """[N, 2, 16] limb cells → [N] bool on-curve mask (mod p — canonicity
+    is the caller's separate check)."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = xy.shape[0]
+    n_pad = -(-n // TILE) * TILE
+    buf = np.zeros((n_pad, 2, fe.LIMBS), dtype=np.int64)
+    buf[:n] = xy
+    buf[n:, 1, 0] = 1  # affine identity padding: on-curve
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n_pad // TILE,),
+        in_specs=[
+            pl.BlockSpec((TILE, 2, fe.LIMBS), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((fe.LIMBS * fe.LIMBS, 2 * fe.LIMBS - 1),
+                         lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((fe.LIMBS,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((fe.LIMBS,), lambda i: (0,),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((TILE, 1), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), np.int32),
+        interpret=jax.default_backend() != "tpu",
+    )(buf, fe.CONV, np.asarray(fe.EIGHT_P), fe.D_LIMBS.astype(np.int64))
+    return np.asarray(out[:n, 0]).astype(bool)

@@ -209,6 +209,17 @@ def _vandermonde_pinv(xs_key: tuple, poly_size: int) -> np.ndarray:
     return pinv
 
 
+def _device_kernels():
+    """The armed accelerator crypto plane (crypto/kernels) or None —
+    recovery's device seam (--device-crypto, docs/CRYPTO_KERNELS.md)."""
+    try:
+        from biscotti_tpu.crypto import kernels
+
+        return kernels.active_module()
+    except ImportError:
+        return None
+
+
 def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
                    poly_size: int = POLY_SIZE) -> np.ndarray:
     """[S, C] aggregated shares (+ their x points) → [C, k] int64 chunk
@@ -217,10 +228,26 @@ def recover_coeffs(agg_shares: jax.Array, xs: jax.Array,
     with the rest of the host int64 share pipeline; the least-squares
     solve rides the memoized Vandermonde pseudoinverse (same minimum-norm
     solution lstsq produces for this full-column-rank system — distinct
-    share points keep the Vandermonde full rank)."""
+    share points keep the Vandermonde full rank).
+
+    --device-crypto moves the [k, S] @ [S, C] interpolation matmul onto
+    the accelerator (kernels.shamir_recover), vectorized across every
+    chunk at once; the pseudoinverse itself stays the SAME memoized host
+    factorization, so both backends solve the identical system. Honest
+    share sums sit ≥ 10¹⁰ ulp from the rounding boundary; for
+    adversarially boundary-crafted shares this is the crypto plane's one
+    FLOAT seam, covered by the backend-homogeneity deployment constraint
+    (all miners of a cluster share a crypto backend — the krum_pallas
+    precedent; docs/CRYPTO_KERNELS.md §oracle-parity)."""
     agg = np.asarray(agg_shares)
     xs_key = tuple(int(x) for x in np.asarray(xs).reshape(-1))
     pinv = _vandermonde_pinv(xs_key, poly_size)
+    dev = _device_kernels()
+    if dev is not None:
+        try:
+            return dev.shamir_recover(pinv, agg)
+        except Exception:
+            pass  # exact host matmul below
     sol = pinv @ agg.astype(np.float64)  # [k, C]
     return np.round(sol.T).astype(np.int64)  # [C, k]
 

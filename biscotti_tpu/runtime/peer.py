@@ -41,6 +41,7 @@ import numpy as np
 
 from biscotti_tpu.config import BiscottiConfig, Defense
 from biscotti_tpu.crypto import commitments as cm
+from biscotti_tpu.crypto import kernels as devkern
 from biscotti_tpu.crypto.vrf import VRFKey
 from biscotti_tpu.data import datasets as ds
 from biscotti_tpu.ledger.block import Block, BlockData, Update
@@ -391,6 +392,34 @@ class PeerAgent:
             self.admission.metrics = self.tele.registry
             self.trainer.metrics = self.tele.registry
             self.straggler.metrics = self.tele.registry
+        # accelerator-resident crypto plane (crypto/kernels,
+        # docs/CRYPTO_KERNELS.md): the arming switch AND the instrument
+        # hooks are process-wide — mixed device/CPU peers in ONE process
+        # are unsupported (every real deployment runs one config per
+        # process; in-process harnesses arm whole clusters), and in a
+        # co-hosted harness the LAST-constructed peer's telemetry
+        # receives every crypto_device span/observation (aggregate
+        # totals stay correct; per-node attribution is a known harness
+        # approximation). Armed with telemetry on, the kernel call sites
+        # emit `crypto_device` spans + the biscotti_crypto_device_seconds
+        # histogram, so profile_round / trace_round can split the crypto
+        # critical path into crypto_cpu vs crypto_device. Any
+        # non-qualifying construction CLEARS the hooks so a torn-down
+        # cluster's telemetry never keeps receiving kernel events.
+        devkern.set_enabled(cfg.device_crypto)
+        self.device_crypto = cfg.device_crypto and devkern.available()
+        self._devkern_span_hook = None
+        self._devkern_registry = None
+        if cfg.device_crypto and cfg.telemetry:
+            self._devkern_span_hook = (
+                lambda kernel: self.tele.span("crypto_device",
+                                              kernel=kernel))
+            self._devkern_registry = self.tele.registry
+            devkern.set_metrics_registry(self._devkern_registry)
+            devkern.set_span_hook(self._devkern_span_hook)
+        else:
+            devkern.set_metrics_registry(None)
+            devkern.set_span_hook(None)
         # the controller is wired into the server UNCONDITIONALLY so the
         # inflight accounting (and its gauges) is live even in
         # observability-only runs; a DISABLED plan admits everything
@@ -562,6 +591,18 @@ class PeerAgent:
             if "deadline_s" in row:
                 dl.set(row["deadline_s"], phase=ph)
 
+    def _release_device_hooks(self) -> None:
+        """Teardown half of the device-crypto arming: drop the
+        process-global kernel instrument hooks IF this agent installed
+        them (identity-compared — a later live agent's hooks are left
+        untouched). Without this, the span closure pins the whole agent
+        object graph for the process lifetime and a torn-down cluster's
+        telemetry keeps receiving kernel events."""
+        if self._devkern_span_hook is not None or \
+                self._devkern_registry is not None:
+            devkern.release_hooks(span_hook=self._devkern_span_hook,
+                                  registry=self._devkern_registry)
+
     def telemetry_snapshot(self) -> Dict:
         """THE public observability readout — one structured dict serving
         the `Metrics` RPC, the run() result's `telemetry` key, the chaos
@@ -639,6 +680,17 @@ class PeerAgent:
                     "overlay_aggregate_refused", 0)
                     + self.counters.get("overlay_fallback_forwarded", 0)),
             },
+            # device-crypto readout (docs/CRYPTO_KERNELS.md): present
+            # only when --device-crypto is armed, so the disarmed
+            # snapshot schema stays byte-identical to the seed. The
+            # seconds/calls tallies are the kernel plane's process-wide
+            # accumulators (one armed cluster per process).
+            **({"device_crypto": {
+                "enabled": True,
+                "active": devkern.active(),
+                "seconds": devkern.device_seconds(),
+                "calls": devkern.device_calls(),
+            }} if self.cfg.device_crypto else {}),
         }
 
     async def _h_metrics(self, meta, arrays):
@@ -4338,6 +4390,17 @@ class PeerAgent:
                     break
                 self._trace("checkpoint_rejected", step=step,
                             error="not adoptable")
+        if self.device_crypto:
+            # compile the device-crypto ladders at this deployment's
+            # bucket shapes BEFORE the first round: XLA compile time
+            # belongs to startup, not inside a round deadline (a cold
+            # compile under a fast-timeout harness turns rounds empty).
+            # Concurrent co-hosted peers share the jit cache; the thread
+            # hop keeps the event loop serving while it builds.
+            ck = ss.num_chunks(self.trainer.num_params,
+                               self.cfg.poly_size) * self.cfg.poly_size
+            await asyncio.to_thread(devkern.prewarm, ck)
+            self._trace("device_crypto_prewarmed", grid_points=ck)
         await self.server.start()
         if self.cfg.metrics_port:
             # optional HTTP exposition beside the RPC server: stock
@@ -4398,6 +4461,7 @@ class PeerAgent:
             if self._metrics_server is not None:
                 self._metrics_server.close()
             snapshot = self.telemetry_snapshot()
+            self._release_device_hooks()
             self.tele.close()
             return self._result(snapshot, churned=True)
         except asyncio.CancelledError:
@@ -4411,6 +4475,7 @@ class PeerAgent:
             self.pool.close()
             if self._metrics_server is not None:
                 self._metrics_server.close()
+            self._release_device_hooks()
             self.tele.close()
             raise
         except BaseException as e:
@@ -4423,6 +4488,7 @@ class PeerAgent:
             self.pool.close()
             if self._metrics_server is not None:
                 self._metrics_server.close()
+            self._release_device_hooks()
             self.tele.close()
             raise
         dump = self.chain.dump()
@@ -4443,6 +4509,7 @@ class PeerAgent:
         if self._metrics_server is not None:
             self._metrics_server.close()
         snapshot = self.telemetry_snapshot()
+        self._release_device_hooks()
         self.tele.close()  # final flush of the batched spill
         return self._result(snapshot, chain_dump=dump)
 

@@ -29,8 +29,12 @@ import re
 import sys
 from typing import Dict
 
-DEFAULT_REGRESS = (r"(_s|_seconds|_secs|round_total|bytes_per_round|"
-                   r"_bytes|crypto_s|final_error)$")
+# lower-is-better keys. The negative lookbehind carves the
+# higher-is-better throughput family (`*_points_per_s`, ISSUE 13 device
+# MSM) out of the `_s` suffix match — an MSM getting FASTER must not
+# read as a latency regression.
+DEFAULT_REGRESS = (r"(?<!points_per)(_s|_seconds|_secs|round_total|"
+                   r"bytes_per_round|_bytes|crypto_s|final_error)$")
 
 
 def load_artifact(path: str) -> Dict:

@@ -79,6 +79,34 @@ def cluster_table(results) -> Dict:
                                 if "telemetry" in r])
 
 
+def _device_crypto_report(ns, results) -> Dict:
+    """Which crypto path the cluster actually ran: `path` is "device"
+    only when the plane was armed, available, and at least one kernel
+    actually executed; armed-but-degraded runs say so explicitly."""
+    snaps = [r.get("telemetry", {}).get("device_crypto") for r in results]
+    snaps = [s for s in snaps if s]
+    if not ns.device_crypto or not snaps:
+        return {"enabled": bool(ns.device_crypto), "path": "cpu"}
+    active = any(s.get("active") for s in snaps)
+    seconds: Dict[str, float] = {}
+    calls: Dict[str, int] = {}
+    for s in snaps:
+        # kernel tallies are process-wide accumulators; peers co-hosted
+        # in one process report the same totals — take the max, not sum
+        for k, v in (s.get("seconds") or {}).items():
+            seconds[k] = max(seconds.get(k, 0.0), float(v))
+        for k, v in (s.get("calls") or {}).items():
+            calls[k] = max(calls.get(k, 0), int(v))
+    ran = any(v > 0 for v in calls.values())
+    return {
+        "enabled": True,
+        "available": active,
+        "path": "device" if (active and ran) else "cpu (degraded)",
+        "kernel_seconds": {k: round(v, 4) for k, v in seconds.items()},
+        "kernel_calls": calls,
+    }
+
+
 def main(argv=None) -> int:
     from biscotti_tpu.config import BiscottiConfig, Timeouts
 
@@ -165,6 +193,12 @@ def main(argv=None) -> int:
     ap.add_argument("--overlay-group", type=int, default=0,
                     help="peers per overlay subtree (default: nodes//2, "
                          "so a chaos cluster always has >= 2 subtrees)")
+    ap.add_argument("--device-crypto", type=int, default=0,
+                    help="1 arms the accelerator-resident crypto plane "
+                         "on every peer, so the seeded chaos/poison "
+                         "matrix replays with batched miner crypto on "
+                         "device; the report records which crypto path "
+                         "actually ran (docs/CRYPTO_KERNELS.md)")
     ns = ap.parse_args(argv)
     if ns.flood and not (0 <= ns.flood_node < ns.nodes):
         ap.error(f"--flood-node {ns.flood_node} outside 0..{ns.nodes - 1}")
@@ -218,6 +252,15 @@ def main(argv=None) -> int:
                               bulk_rate=6.0, control_rate=16.0)
     fast = Timeouts(update_s=4.0, block_s=12.0, krum_s=3.0, share_s=4.0,
                     rpc_s=4.0)
+    if ns.device_crypto:
+        # the harness-fast deadlines above exist to keep chaos snappy,
+        # not to time out honest crypto: off real accelerator hardware
+        # the limb kernels run under XLA *CPU* emulation at whole
+        # seconds per settle, which would turn every round empty. Widen
+        # to the byzantine-suite constants so the device path races
+        # steady-state kernels, not the harness clock.
+        fast = Timeouts(update_s=25.0, block_s=75.0, krum_s=15.0,
+                        share_s=25.0, rpc_s=20.0)
 
     overlay_group = 0
     if ns.overlay:
@@ -243,6 +286,7 @@ def main(argv=None) -> int:
             # flood_plan flooder alike — so an overlay chaos run stays
             # one-seed replayable across all composed planes
             overlay=bool(ns.overlay), overlay_group=overlay_group,
+            device_crypto=bool(ns.device_crypto),
             wire_codec=ns.codec)
 
     if ns.churn > 0:
@@ -293,6 +337,11 @@ def main(argv=None) -> int:
                 if (ns.slow > 0 or ns.slow_node >= 0) else None,
         "adaptive_deadlines": bool(ns.adaptive_deadlines),
         "admission_enabled": admit,
+        # which crypto path the run ACTUALLY took (docs/CRYPTO_KERNELS.md):
+        # armed-but-unavailable degrades to cpu, and the per-kernel
+        # seconds prove the device plane ran rather than just being
+        # requested — read off the peers' telemetry snapshots
+        "device_crypto": _device_crypto_report(ns, results),
         # aggregation-overlay readout (docs/OVERLAY.md): the armed knobs
         # plus the cluster's aggregated/direct/fallback tallies
         # (obs.merge_overlay — one definition with a live scrape)

@@ -45,6 +45,13 @@ _NON_WORK_PHASES = frozenset({
     "rpc_call", "block_wait", "intake_wait", "mint",
 })
 
+# counted into the phase totals (they ARE work — the crypto_split_s row
+# reads them) but NOT into serial_s: a crypto_device span is nested
+# inside the host phase (miner_verify / intake_fold / recovery) that
+# invoked the kernel, whose own span already covers the same seconds —
+# double-charging would report the device time as phantom overlap
+_NESTED_WORK_PHASES = frozenset({"crypto_device"})
+
 
 def collect_round_table(agents) -> Dict:
     """Aggregate span/trace events from live agents' flight recorders
@@ -86,7 +93,8 @@ def collect_round_table(agents) -> Dict:
                 r = per.setdefault((node, it), {"serial_s": 0.0,
                                                 "start": None, "end": None})
                 dur = float(ev.get("dur_s", 0.0))
-                r["serial_s"] += dur
+                if phase not in _NESTED_WORK_PHASES:
+                    r["serial_s"] += dur
                 phases[phase] = phases.get(phase, 0.0) + dur
             elif name == "round_start" and it is not None:
                 r = per.setdefault((node, it), {"serial_s": 0.0,
@@ -127,11 +135,30 @@ def collect_round_table(agents) -> Dict:
                                key=lambda kv: kv[1])[0]
             row["trace_spans"] = span_count.get(it, 0)
         table.append(row)
+    # crypto residency split (ISSUE 13): how much of the phase time was
+    # host EC/bigint work vs device-kernel work, judged by the same
+    # phase → segment taxonomy the trace_round critical path uses.
+    # crypto_device spans are tagged at the kernel call sites, NESTED
+    # inside the host crypto phase that invoked them (prewarm spans are
+    # suppressed at the source), so the device seconds are SUBTRACTED
+    # from the host-phase total: crypto_cpu is the wrapper/bigint work
+    # that actually stayed on the CPU, and the two rows sum to the
+    # crypto phase time instead of double-counting the moved portion.
+    from biscotti_tpu.tools import trace_round as _tr
+
+    crypto_split = {_tr.CRYPTO_CPU: 0.0, _tr.CRYPTO_DEVICE: 0.0}
+    for phase, total in phases.items():
+        seg = _tr.segment_of(phase)
+        if seg in crypto_split:
+            crypto_split[seg] += total
+    crypto_split[_tr.CRYPTO_CPU] = max(
+        0.0, crypto_split[_tr.CRYPTO_CPU] - crypto_split[_tr.CRYPTO_DEVICE])
     return {
         "rounds": table,
         "phase_totals_s": {k: round(v, 4)
                            for k, v in sorted(phases.items(),
                                               key=lambda kv: -kv[1])},
+        "crypto_split_s": {k: round(v, 4) for k, v in crypto_split.items()},
         "crypto_batch_sizes": sorted(batch_sizes),
     }
 
@@ -146,6 +173,11 @@ def main(argv=None) -> int:
     ap.add_argument("--pipeline", type=int, default=1,
                     help="1 = pipelined engine (overlap + speculation + "
                          "batched intake); 0 = the serial seed schedule")
+    ap.add_argument("--device-crypto", type=int, default=0,
+                    help="1 = run the harness cluster with the "
+                         "accelerator-resident crypto plane armed, so "
+                         "the crypto_split_s row shows what moved "
+                         "on-device (docs/CRYPTO_KERNELS.md)")
     ap.add_argument("--base-port", type=int, default=28410)
     ap.add_argument("--json", default="",
                     help="also write the table to this path")
@@ -179,6 +211,7 @@ def main(argv=None) -> int:
             sample_percent=0.70, seed=2, timeouts=timeouts,
             pipeline=bool(args.pipeline), speculation=bool(args.pipeline),
             batch_intake=bool(args.pipeline), trace=bool(args.trace),
+            device_crypto=bool(args.device_crypto),
         )
         for i in range(args.nodes)
     ]
@@ -205,6 +238,7 @@ def main(argv=None) -> int:
               + (f" ({row['trace_spans']} spans)"
                  if row.get("trace_spans") else ""))
     print("phase totals:", json.dumps(out["phase_totals_s"]))
+    print("crypto split:", json.dumps(out["crypto_split_s"]))
     if out["crypto_batch_sizes"]:
         bs = out["crypto_batch_sizes"]
         print(f"crypto batches: n={len(bs)} sizes min/med/max = "

@@ -31,7 +31,10 @@ tree per round ACROSS peers. This tool:
      point (the last block acceptance), swept so every instant of the
      chain window is attributed to the deepest covering span, gaps
      filled with the owning node's concurrent spans. Segments:
-     device / crypto / wire / relay / parked / other / untraced.
+     device / crypto_cpu / crypto_device / wire / relay / parked /
+     other / untraced — the crypto segment is split by residency so a
+     --device-crypto run shows exactly what moved onto the accelerator
+     (crypto_device spans are tagged at the kernel call sites).
   4. **Exports** Chrome trace-event JSON (one process per peer, greedy
      lane assignment, flow arrows on cross-node parent links) loadable
      in Perfetto / chrome://tracing, plus a text critical-path table.
@@ -53,7 +56,17 @@ from typing import Dict, List, Optional, Tuple
 # ------------------------------------------------------ segment taxonomy
 
 DEVICE = "device"
-CRYPTO = "crypto"
+# the crypto segment is split by residency (ISSUE 13): crypto_cpu is the
+# host bigint/EC work, crypto_device the limb-kernel work the
+# --device-crypto plane moved onto the accelerator. `crypto_device`
+# spans are emitted at the kernel call sites (crypto/kernels/instrument
+# → Telemetry.span), nested inside the host phase that invoked them, so
+# the deepest-covering-span sweep attributes exactly the moved portion.
+CRYPTO_CPU = "crypto_cpu"
+CRYPTO_DEVICE = "crypto_device"
+# legacy alias: pre-split consumers (and the r11 trace artifacts) called
+# the whole host-crypto segment "crypto" — it maps to the CPU half
+CRYPTO = CRYPTO_CPU
 WIRE = "wire"
 RELAY = "relay"
 PARKED = "parked"
@@ -62,10 +75,13 @@ UNTRACED = "untraced"
 
 _SEGMENT_EXACT = {
     "sgd": DEVICE, "spec_sgd": DEVICE, "metrics": DEVICE,
-    "crypto_commit": CRYPTO, "spec_commit": CRYPTO, "share_gen": CRYPTO,
-    "miner_verify": CRYPTO, "sig_check": CRYPTO, "intake_validate": CRYPTO,
-    "intake_fold": CRYPTO, "recovery": CRYPTO, "reshare_verify": CRYPTO,
-    "reshare_deal": CRYPTO, "mint": CRYPTO,
+    "crypto_commit": CRYPTO_CPU, "spec_commit": CRYPTO_CPU,
+    "share_gen": CRYPTO_CPU, "miner_verify": CRYPTO_CPU,
+    "sig_check": CRYPTO_CPU, "intake_validate": CRYPTO_CPU,
+    "intake_fold": CRYPTO_CPU, "recovery": CRYPTO_CPU,
+    "reshare_verify": CRYPTO_CPU, "reshare_deal": CRYPTO_CPU,
+    "mint": CRYPTO_CPU,
+    "crypto_device": CRYPTO_DEVICE,
     "rpc_call": WIRE,
     "overlay_aggregate": RELAY,
     "rpc.RelayFrames": RELAY, "rpc.OverlayOffer": RELAY,
