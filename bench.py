@@ -682,6 +682,70 @@ def bench_straggler_degradation(n=10, rounds=3, budget_s=600.0):
     return out
 
 
+def bench_attack_matrix(budget_s: float = 600.0):
+    """Attack-matrix guard cells (ISSUE 14): the static-vs-adaptive
+    poisoner pair under the accept-mask defenses, live at the matrix's
+    operating point (eval/eval_attack_matrix.py --quick). The full
+    matrix is the eval artifact (eval/results/attack_matrix.json);
+    these rows ride the BENCH artifact so `tools/bench_diff` fails
+    loudly when a future PR flips a survived cell (`failed` 0 -> 1) or
+    lets more poisoned sources through (`accepted_poisoned_n`).
+
+    Set BISCOTTI_BENCH_ATTACK=0 to skip."""
+    if os.environ.get("BISCOTTI_BENCH_ATTACK", "1") == "0":
+        return {"skipped": "BISCOTTI_BENCH_ATTACK=0"}
+
+    import importlib.util
+    from types import SimpleNamespace
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "eval", "eval_attack_matrix.py")
+    spec = importlib.util.spec_from_file_location("eval_attack_matrix",
+                                                  path)
+    am = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(am)
+
+    from biscotti_tpu.config import Defense
+
+    # the matrix driver's default operating point (mnist@dir0.3, 10
+    # nodes, 3 verifiers, one seed) — per-cell calls so the budget is
+    # enforced BETWEEN cells like every sibling bench entry
+    ns = SimpleNamespace(nodes=10, verifiers=3, rounds=8, seed=11,
+                         poison=0.3, flood=30, dataset="mnist@dir0.3")
+    cells = [("static", Defense.KRUM), ("hug", Defense.KRUM),
+             ("static", Defense.FOOLSGOLD), ("hug", Defense.FOOLSGOLD)]
+    out = {"complete": True}
+    deadline = time.time() + budget_s
+    port = 14190
+    for camp, d in cells:
+        name = f"{camp}_{d.value.lower()}"
+        if time.time() > deadline - 30:
+            out[name] = {"error": "attack-matrix budget exhausted"}
+            out["complete"] = False
+            continue
+        _progress(f"attack_matrix: {name} (live cell)")
+        try:
+            row = am.run_cell(camp, d, True, port, ns)
+            # the survival bits (failed / accepted_poisoned_n) are the
+            # regression-gated keys; the live-cluster error is noisy
+            # run-to-run (round intake varies with box load), so it
+            # rides as `anchor_error` — informational, outside the
+            # bench_diff final_error regress pattern
+            out[name] = {k: row[k] for k in
+                         ("chains_equal", "survived",
+                          "failed", "accepted_poisoned_n")}
+            out[name]["anchor_error"] = row["final_error"]
+            _progress(f"attack_matrix: {name} survived="
+                      f"{row['survived']} err={row['final_error']}")
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+            out["complete"] = False
+            _progress(f"attack_matrix: {name} failed: "
+                      f"{out[name]['error']}")
+        port += ns.nodes + 2
+    return out
+
+
 def main():
     import jax
 
@@ -763,6 +827,11 @@ def main():
     # 0/10/20% slowed peers, fixed vs adaptive deadlines
     straggler = bench_straggler_degradation()
 
+    # attack-matrix guard cells (ISSUE 14): static vs adaptive poisoner
+    # under the accept-mask defenses — bench_diff fails loudly when a
+    # survived cell flips
+    attack_matrix = bench_attack_matrix()
+
     # device-crypto microbench (ISSUE 13): CPU vs device MSM across
     # intake widths {8, 35, 100} — the scaling evidence for the
     # accelerator-resident crypto plane
@@ -786,6 +855,7 @@ def main():
         "configs": rows,
         "peer_density": density,
         "straggler_degradation": straggler,
+        "attack_matrix": attack_matrix,
         "crypto_kernel": crypto_kernel,
     }
     # Full per-config detail goes to a file + stderr; stdout carries exactly
@@ -832,6 +902,11 @@ def main():
         # profile, fixed vs adaptive deadlines — the robustness number
         # the straggler-tolerance plane exists to move
         "straggler_degradation": straggler,
+        # attack-matrix guard cells (runtime/adversary.py): survival +
+        # accepted-poison bits for the static/hug x KRUM/FOOLSGOLD
+        # cells — a flipped survived cell is a bench_diff regression
+        # (docs/ADVERSARY.md; full matrix in eval/results/)
+        "attack_matrix": attack_matrix,
         # device-crypto microbench (crypto/kernels): CPU vs device MSM
         # across intake widths — the scaling evidence behind
         # --device-crypto (docs/CRYPTO_KERNELS.md)

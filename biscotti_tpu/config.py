@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 # token buckets): safe to import here without dragging the asyncio
 # runtime into config users
 from biscotti_tpu.runtime.admission import AdmissionPlan
+from biscotti_tpu.runtime.adversary import CAMPAIGNS, CampaignPlan
 from biscotti_tpu.runtime.faults import SLOW_PRESETS, FaultPlan
 
 
@@ -203,6 +204,15 @@ class BiscottiConfig:
     # never advances the circuit breaker. Default = disabled (seed
     # behavior: admit everything, park without bound).
     admission_plan: AdmissionPlan = field(default_factory=AdmissionPlan)
+    # adaptive-adversary campaign plane (runtime/adversary.py,
+    # docs/ADVERSARY.md): seeded, state-observing attack strategies —
+    # role-aware coordinated flood, churn-riding identity recycling,
+    # threshold-hugging adaptive poison. Armed only on the peers the
+    # plan draws as attackers; every decision is a pure function of
+    # (campaign seed, observed protocol state) and is traced + counted
+    # (biscotti_campaign_actions_total). Default = disabled: the seed
+    # schedule, bit-identical (guarded by tests/test_adversary.py).
+    campaign_plan: CampaignPlan = field(default_factory=CampaignPlan)
 
     # --- straggler-tolerance plane (runtime/stragglers.py,
     # docs/STRAGGLERS.md) ---
@@ -400,6 +410,26 @@ class BiscottiConfig:
         # an enabled admission plan with nonsensical caps must fail at
         # construction, not mid-round when the first frame is budgeted
         self.admission_plan.validate()
+        # campaign plane: a typo'd campaign name or nonsensical knob
+        # must fail at construction too; fedsys has no election to
+        # observe, no stake and no committees — an "adaptive" adversary
+        # there would silently be the static one, so refuse the
+        # combination instead of mislabeling a run
+        self.campaign_plan.validate()
+        if self.campaign_plan.enabled \
+                and self.campaign_plan.attacker_node >= self.num_nodes:
+            raise ValueError(
+                f"campaign_plan.attacker_node="
+                f"{self.campaign_plan.attacker_node} outside the id "
+                f"space 1..{self.num_nodes - 1}: attacker_ids would "
+                "silently drop the pin and the run would be an honest "
+                "cluster labeled as an attack scenario")
+        if self.campaign_plan.enabled and self.fedsys:
+            raise ValueError(
+                "campaign_plan is incompatible with fedsys mode: the "
+                "campaigns adapt to the VRF election and chain state, "
+                "which the FedSys baseline does not have "
+                "(docs/ADVERSARY.md)")
         if not (0.0 <= self.fault_plan.churn < 1.0):
             raise ValueError(
                 f"fault_plan.churn={self.fault_plan.churn} must be in "
@@ -654,6 +684,51 @@ class BiscottiConfig:
                        help="1: distributed Shamir resharing round when "
                             "a miner is lost mid-round (0 = seed "
                             "behavior, the round goes empty)")
+        p.add_argument("--campaign", type=str,
+                       default=CampaignPlan.campaign,
+                       choices=[""] + list(CAMPAIGNS),
+                       help="arm an adaptive-adversary campaign on the "
+                            "peers the plan draws as attackers: "
+                            "roleflood = flood the per-round elected "
+                            "miner/noisers, sybil = churn-riding "
+                            "identity recycling, hug = threshold-"
+                            "hugging adaptive poisoner "
+                            "(docs/ADVERSARY.md; '' = seed behavior)")
+        p.add_argument("--campaign-seed", type=int,
+                       default=CampaignPlan.seed,
+                       help="campaign decision seed (-1: the protocol "
+                            "--seed) — same seed + same chain = the "
+                            "identical action schedule")
+        p.add_argument("--campaign-attackers", type=float,
+                       default=CampaignPlan.attackers,
+                       help="membership fraction drawn as colluding "
+                            "attackers (top ids — the poisoned-id "
+                            "formula, so matching --poison-fraction "
+                            "makes the sets identical)")
+        p.add_argument("--campaign-node", type=int,
+                       default=CampaignPlan.attacker_node,
+                       help="pin this id into the attacker set (-1: "
+                            "none; node 0 refused — oracle anchor)")
+        p.add_argument("--campaign-flood", type=int,
+                       default=CampaignPlan.flood,
+                       help="targeted frame-replay factor for the "
+                            "roleflood campaign (frames toward a "
+                            "target are written 1+N times)")
+        p.add_argument("--campaign-recycle-period", type=int,
+                       default=CampaignPlan.recycle_period,
+                       help="sybil: rounds between identity recycles")
+        p.add_argument("--campaign-recycle-down", type=int,
+                       default=CampaignPlan.recycle_down,
+                       help="sybil: rounds down before the fresh "
+                            "incarnation rejoins")
+        p.add_argument("--campaign-hug-start", type=float,
+                       default=CampaignPlan.hug_start,
+                       help="hug: initial poison blend scale")
+        p.add_argument("--campaign-hug-jitter", type=float,
+                       default=CampaignPlan.hug_jitter,
+                       help="hug: per-attacker decorrelation jitter as "
+                            "a fraction of the observed honest step "
+                            "norm")
         p.add_argument("--admission", type=int,
                        default=int(AdmissionPlan.enabled),
                        help="1 arms the overload-governance plane: "
@@ -855,6 +930,23 @@ class BiscottiConfig:
                                     FaultPlan.slow_preset),
                 slow_node=getattr(ns, "fault_slow_node",
                                   FaultPlan.slow_node),
+            ),
+            campaign_plan=CampaignPlan(
+                campaign=getattr(ns, "campaign", CampaignPlan.campaign),
+                seed=getattr(ns, "campaign_seed", CampaignPlan.seed),
+                attackers=getattr(ns, "campaign_attackers",
+                                  CampaignPlan.attackers),
+                attacker_node=getattr(ns, "campaign_node",
+                                      CampaignPlan.attacker_node),
+                flood=getattr(ns, "campaign_flood", CampaignPlan.flood),
+                recycle_period=getattr(ns, "campaign_recycle_period",
+                                       CampaignPlan.recycle_period),
+                recycle_down=getattr(ns, "campaign_recycle_down",
+                                     CampaignPlan.recycle_down),
+                hug_start=getattr(ns, "campaign_hug_start",
+                                  CampaignPlan.hug_start),
+                hug_jitter=getattr(ns, "campaign_hug_jitter",
+                                   CampaignPlan.hug_jitter),
             ),
             admission_plan=AdmissionPlan(
                 enabled=bool(getattr(ns, "admission",

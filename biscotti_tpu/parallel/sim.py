@@ -102,11 +102,13 @@ def masked_aggregate(mask: jax.Array, deltas: jax.Array, noised: jax.Array,
 
 def _poisoned_ids(num_nodes: int, poison_fraction: float) -> set:
     """Top poison_fraction of node ids load bad shards
-    (ref: DistSys/main.go:836-845, honest.go:102-118)."""
-    if poison_fraction <= 0:
-        return set()
-    poisoning_index = math.ceil(num_nodes * (1.0 - poison_fraction))
-    return {i for i in range(num_nodes) if i > poisoning_index}
+    (ref: DistSys/main.go:836-845, honest.go:102-118). THE formula lives
+    in tools/verdicts.poisoned_ids — one definition shared with the live
+    runtime, the campaign plane's attacker draw, and every verdict
+    reader; this name stays as the sim-side alias."""
+    from biscotti_tpu.tools.verdicts import poisoned_ids
+
+    return poisoned_ids(num_nodes, poison_fraction)
 
 
 class Simulator:

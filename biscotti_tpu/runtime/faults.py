@@ -381,6 +381,13 @@ class FaultInjector:
         self._peer_of = peer_of
         self._seq: Dict[Tuple[int, str], int] = {}
         self.counts: Dict[str, int] = {}
+        # optional adversary campaign (runtime/adversary.py): consulted
+        # per frame for TARGETED extra replays (the role-aware flood) on
+        # top of the plan's static draw — the campaign plane's one
+        # frame-level seam, so chaos schedules stay layout-invariant
+        # (the draw happens before any loopback shortcut, like every
+        # other fault kind)
+        self.campaign = None
         # optional telemetry registry (telemetry.MetricsRegistry): armed
         # by the peer agent so injected-fault tallies ride the same
         # scrapeable plane as everything else; `counts` stays as the
@@ -398,6 +405,18 @@ class FaultInjector:
         seq = self._seq.get(key, 0)
         self._seq[key] = seq + 1
         act = self.plan.action(self.src, dst, msg_type, attempt, seq)
+        if self.campaign is not None and not (act.reset or act.drop):
+            # role-aware targeted flood: the campaign names this round's
+            # targets from the election it observed; a frame bound for
+            # one of them is replayed like the static flood kind, same
+            # precedence (a reset/dropped frame cannot also storm). The
+            # campaign's tallies count only storms that actually FIRE —
+            # a plan-level flood >= the campaign's supersedes it
+            extra = self.campaign.flood_factor(dst, msg_type)
+            if extra > act.flood:
+                act = FaultAction(duplicate=act.duplicate,
+                                  delay_s=act.delay_s, flood=extra)
+                self.campaign.record_flood(dst)
         kind = act.kind()
         if kind != "none":
             self.counts[kind] = self.counts.get(kind, 0) + 1

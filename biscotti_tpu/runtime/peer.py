@@ -51,6 +51,7 @@ from biscotti_tpu.ops import secretshare as ss
 from biscotti_tpu.parallel import roles as R
 from biscotti_tpu.parallel.sim import _poisoned_ids
 from biscotti_tpu.runtime import admission as adm
+from biscotti_tpu.runtime import adversary
 from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
 from biscotti_tpu.runtime import overlay as ov
@@ -507,6 +508,38 @@ class PeerAgent:
                 e.round for e in cfg.fault_plan.churn_schedule(
                     cfg.num_nodes, cfg.max_iterations)
                 if e.node == self.id and e.kind == faults.KILL)
+        # adaptive-adversary campaign plane (runtime/adversary.py,
+        # docs/ADVERSARY.md): armed only on the peers the plan draws as
+        # attackers — every other peer (and every disabled plan) runs
+        # the seed protocol untouched, allocation-free. Decisions are
+        # pure functions of (campaign seed, observed protocol state),
+        # so a campaign run replays from its flags like any fault run.
+        self.campaign = adversary.build(cfg.campaign_plan, self.id,
+                                        cfg.num_nodes, cfg.seed)
+        # latest round this peer actually submitted an update for — how
+        # the campaign reads its own submission's fate out of the next
+        # block (absent record after a submission = rejected)
+        self._campaign_submitted: int = -1
+        if self.campaign is not None:
+            if cfg.telemetry:
+                self.campaign.metrics = self.tele.registry
+            # frame-level actions ride the fault plane's injector seam;
+            # construct one even when no frame faults are armed (a
+            # disabled plan draws benign for every frame, so only the
+            # campaign's targeted replays fire)
+            if self.pool.faults is None:
+                self.pool.faults = faults.FaultInjector(
+                    cfg.fault_plan, self.id, self._peer_for_addr)
+                if cfg.telemetry:
+                    self.pool.faults.metrics = self.tele.registry
+            self.pool.faults.campaign = self.campaign
+            # identity recycling rides the churn self-kill seam: the
+            # sybil schedule's kills join ours, and the launcher
+            # (ChurnRunner / chaos --campaign / any supervisor)
+            # relaunches the fresh incarnation
+            self._churn_kills = frozenset(
+                self._churn_kills
+                | self.campaign.kill_rounds(cfg.max_iterations))
 
     # ------------------------------------------------------------ utilities
 
@@ -691,6 +724,14 @@ class PeerAgent:
                 "seconds": devkern.device_seconds(),
                 "calls": devkern.device_calls(),
             }} if self.cfg.device_crypto else {}),
+            # adversary-campaign readout (docs/ADVERSARY.md): present
+            # only on an ARMED attacker peer, so the honest/disabled
+            # snapshot schema stays byte-identical to the seed. The
+            # `schedule` list is the deterministic decision log the
+            # layout-invariance tests compare; actions/targets_hit are
+            # execution tallies.
+            **({"campaign": self.campaign.snapshot()}
+               if self.campaign is not None else {}),
         }
 
     async def _h_metrics(self, meta, arrays):
@@ -1097,6 +1138,81 @@ class PeerAgent:
         if self.trainer.light:
             return await self.stepper.noise(self.id, it)
         return self.trainer.get_noise(it)
+
+    # -------------------------------------------------- campaign plane
+
+    def _campaign_observe(self, it: int) -> None:
+        """Per-round adversary observation (docs/ADVERSARY.md): feed the
+        campaign exactly what a real attacker at this peer can see — the
+        public committee election (a pure function of chain state every
+        peer computes anyway) and its own submission's fate in the
+        latest block — and trace the decisions it returns. Pure in
+        (campaign seed, observed chain state), so the same seed yields
+        the identical action schedule on any transport layout."""
+        verifiers, miners, _, _ = self.role_map.committee()
+        accepted_last: Optional[bool] = None
+        blk = self.chain.latest
+        if self._campaign_submitted >= 0 \
+                and blk.iteration == self._campaign_submitted:
+            # we submitted for the round this block settled: accepted iff
+            # our record rides it with accepted=True (a verifier
+            # rejection leaves no record at all — also a False)
+            accepted_last = any(u.source_id == self.id and u.accepted
+                                for u in blk.data.deltas)
+        decided = self.campaign.observe_round(
+            it, miners=sorted(miners), verifiers=list(verifiers),
+            accepted_last=accepted_last)
+        if decided:
+            self._trace("campaign_round", campaign=self.campaign.name,
+                        **decided)
+
+    def _campaign_honest_step(self) -> Optional[np.ndarray]:
+        """The attacker's estimate of one honest accepted delta: the
+        latest block's applied aggregate (global_w difference). Under
+        the default sum aggregation (Biscotti SUMS accepted deltas, see
+        _create_block) that difference is divided by the accepted
+        count; TRIMMED_MEAN applies a per-coordinate MEAN, so the
+        difference is already one-delta scale. Chain-derived only —
+        nothing here an observer of the gossip plane could not
+        compute (the aggregation rule is public config)."""
+        cur = self.chain.latest
+        if cur.iteration < 0:
+            return None
+        prev = self.chain.get_block(cur.iteration - 1)
+        if prev is None:
+            return None  # pruned away (snapshot-bootstrapped attacker)
+        n_acc = sum(1 for u in cur.data.deltas if u.accepted)
+        if n_acc == 0:
+            return None
+        step = cur.data.global_w - prev.data.global_w
+        if self.cfg.defense == Defense.TRIMMED_MEAN:
+            return step
+        return step / float(n_acc)
+
+    def _campaign_shape(self, it: int, delta: np.ndarray) -> np.ndarray:
+        """Adaptive-poison post-processing of OUR OWN delta (the one
+        thing an attacker may always tamper with): blend toward the
+        observed honest step at the campaign's current scale, plus the
+        seeded per-attacker decorrelation jitter. The campaign decides
+        (scale, jitter seed, jitter fraction); the arithmetic lives
+        here where numpy does."""
+        sh = self.campaign.shape(it)
+        if sh is None:
+            return delta
+        scale, jitter_seed, jitter_frac = sh
+        est = self._campaign_honest_step()
+        if est is None:
+            est = np.zeros_like(delta)
+        shaped = est + scale * (delta - est)
+        if jitter_frac > 0.0:
+            rng = np.random.default_rng(jitter_seed)
+            j = rng.standard_normal(delta.shape)
+            nj = float(np.linalg.norm(j))
+            ref = float(np.linalg.norm(est)) or float(np.linalg.norm(delta))
+            if nj > 0.0 and ref > 0.0:
+                shaped = shaped + j * (jitter_frac * ref / nj)
+        self._trace("campaign_poison", scale=round(float(scale), 4))
+        return np.asarray(shaped, delta.dtype)
 
     # ------------------------------------------------- straggler plane
 
@@ -3114,6 +3230,17 @@ class PeerAgent:
             await self._slow_pad(base)
         self.total_updates += 1
 
+        if self.campaign is not None:
+            # adaptive-poison seam (docs/ADVERSARY.md): the campaign may
+            # reshape OUR OWN delta before quantize/commit/noise/share —
+            # everything downstream (Pedersen verification, Shamir
+            # recovery, defense scoring) operates on the shaped values,
+            # exactly as it would on any delta a hostile trainer emits.
+            # Recording the submission round is how the campaign reads
+            # its own fate out of the next block.
+            delta = self._campaign_shape(it, delta)
+            self._campaign_submitted = it
+
         noise = None
         if cfg.dp_in_model:
             delta = delta + await self._own_noise(it)
@@ -3129,6 +3256,12 @@ class PeerAgent:
         noised = delta
         if cfg.noising and not cfg.fedsys:
             draw = self._noiser_draw()
+            if self.campaign is not None:
+                # the one committee an attacker can observe beyond the
+                # public election: its OWN private noiser draw — the
+                # roleflood campaign adds the drawn noisers to this
+                # round's flood targets (docs/ADVERSARY.md)
+                self.campaign.observe_noisers(it, draw.noisers)
             nmeta = {
                 "iteration": it, "source_id": self.id,
                 "noisers": list(draw.noisers),
@@ -4149,6 +4282,15 @@ class PeerAgent:
         self._trace("round_start",
                     verifier=self.role_map.is_verifier(self.id),
                     miner=self.role_map.is_miner(self.id))
+
+        # adversary observation hook (docs/ADVERSARY.md): an armed
+        # campaign sees what any participant at this peer sees — the
+        # public election just computed above and the latest block —
+        # and fixes this round's actions (flood targets, recycle,
+        # poison scale) BEFORE any of them fire (the self-kill below
+        # included, so a recycle is counted before it executes)
+        if self.campaign is not None:
+            self._campaign_observe(it)
 
         # seeded churn self-kill (--fault-churn, docs/MEMBERSHIP.md): this
         # round is OUR scheduled death — exit cleanly so the launcher can

@@ -32,9 +32,13 @@ from typing import Dict
 # lower-is-better keys. The negative lookbehind carves the
 # higher-is-better throughput family (`*_points_per_s`, ISSUE 13 device
 # MSM) out of the `_s` suffix match — an MSM getting FASTER must not
-# read as a latency regression.
+# read as a latency regression. `failed` / `accepted_poisoned_n` are the
+# attack-matrix survival bits (eval/eval_attack_matrix.py): a survived
+# cell flipping to failed (0 → 1) or a defense letting MORE poisoned
+# sources through must fail a bench diff loudly.
 DEFAULT_REGRESS = (r"(?<!points_per)(_s|_seconds|_secs|round_total|"
-                   r"bytes_per_round|_bytes|crypto_s|final_error)$")
+                   r"bytes_per_round|_bytes|crypto_s|final_error|"
+                   r"failed|accepted_poisoned_n)$")
 
 
 def load_artifact(path: str) -> Dict:

@@ -69,6 +69,11 @@ def tally_faults(results) -> Dict[str, int]:
     return fired
 
 
+from biscotti_tpu.config import Defense as _Defense
+from biscotti_tpu.runtime import adversary as _adversary
+from biscotti_tpu.tools import verdicts as _verdicts
+
+
 def cluster_table(results) -> Dict:
     """Merged cluster view over the per-peer telemetry snapshots — one
     definition shared with `python -m biscotti_tpu.tools.obs` (which
@@ -115,6 +120,15 @@ def main(argv=None) -> int:
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--base-port", type=int, default=13900)
     ap.add_argument("--dataset", default="creditcard")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="protocol seed for every peer (keys, sampling, "
+                         "committee draws) — one seed replays a whole "
+                         "attack-matrix cell (eval/eval_attack_matrix)")
+    ap.add_argument("--verifiers", type=int, default=1,
+                    help="verifier committee size (attack-matrix cells "
+                         "use 3: majority approval keeps one colluding "
+                         "verifier from rubber-stamping its fellow "
+                         "poisoners)")
     ap.add_argument("--secure-agg", type=int, default=0)
     ap.add_argument("--verification", type=int, default=0)
     ap.add_argument("--fault-seed", type=int, default=0)
@@ -135,12 +149,56 @@ def main(argv=None) -> int:
                          "flooder: every frame it sends is replayed this "
                          "many extra times (e.g. 50 = 51x the honest "
                          "frame rate)")
-    ap.add_argument("--flood-node", type=int, default=1,
-                    help="which peer floods (miners are stake-elected "
-                         "per round, so in some rounds the flooder may "
-                         "itself be the minter — its shed block pushes "
-                         "then heal via advertise/pull, see "
-                         "docs/ADMISSION.md)")
+    ap.add_argument("--flood-node", type=str, default="1",
+                    help="an id: that peer floods blind (every frame, "
+                         "every destination — the legacy static storm). "
+                         "The sentinel `miner` aims the flood instead: "
+                         "the flooding peer (--flood-from) replays only "
+                         "frames bound for the PER-ROUND elected miner, "
+                         "resolved via the campaign plane's observation "
+                         "hook (docs/ADVERSARY.md) — miners are stake-"
+                         "elected per round, and the flood now follows "
+                         "the election")
+    ap.add_argument("--flood-from", type=int, default=1,
+                    help="which peer floods when --flood-node is a role "
+                         "sentinel (default 1; node 0 is the oracle "
+                         "anchor and refused)")
+    ap.add_argument("--campaign", type=str, default="",
+                    choices=[""] + list(_adversary.CAMPAIGNS),
+                    help="arm an adaptive-adversary campaign "
+                         "(docs/ADVERSARY.md) on the drawn attacker "
+                         "peers: roleflood = flood the per-round "
+                         "elected miner/noisers, sybil = churn-riding "
+                         "identity recycling (runs under the "
+                         "ChurnRunner so fresh incarnations relaunch), "
+                         "hug = threshold-hugging adaptive poisoner")
+    ap.add_argument("--campaign-attackers", type=float, default=0.0,
+                    help="membership fraction drawn as attackers (top "
+                         "ids — the poisoned-id formula, so matching "
+                         "--poison makes the colluding and poisoned "
+                         "sets identical)")
+    ap.add_argument("--campaign-node", type=int, default=-1,
+                    help="pin this id into the attacker set (-1: none)")
+    ap.add_argument("--campaign-flood", type=int, default=20,
+                    help="targeted replay factor for the roleflood "
+                         "campaign")
+    ap.add_argument("--campaign-recycle-period", type=int, default=4,
+                    help="sybil: rounds between identity recycles "
+                         "(--rounds must exceed it for any recycle to "
+                         "land)")
+    ap.add_argument("--campaign-recycle-down", type=int, default=1,
+                    help="sybil: rounds a recycled attacker stays down")
+    ap.add_argument("--campaign-seed", type=int, default=-1,
+                    help="campaign decision seed (-1: the cluster seed)")
+    ap.add_argument("--poison", type=float, default=0.0,
+                    help="poison_fraction: top ids train on label-"
+                         "flipped shards (the reference attack); "
+                         "composes with --campaign for the "
+                         "flood-while-poisoning scenarios")
+    ap.add_argument("--defense", type=str, default="NONE",
+                    choices=[d.value for d in _Defense],
+                    help="poisoning defense for the cluster; any "
+                         "non-NONE choice arms verification")
     ap.add_argument("--admission", type=int, default=-1,
                     help="1 arms the overload-governance plane on every "
                          "peer; 0 disables; default: armed iff --flood")
@@ -200,13 +258,83 @@ def main(argv=None) -> int:
                          "device; the report records which crypto path "
                          "actually ran (docs/CRYPTO_KERNELS.md)")
     ns = ap.parse_args(argv)
-    if ns.flood and not (0 <= ns.flood_node < ns.nodes):
-        ap.error(f"--flood-node {ns.flood_node} outside 0..{ns.nodes - 1}")
+    # --flood-node: a static id, or the `miner` sentinel (per-round
+    # elected-miner targeting via the campaign plane's observation hook)
+    flood_at_miner = ns.flood_node == "miner"
+    if flood_at_miner:
+        flood_node = -1  # no blanket flood plan; the campaign targets
+        if not (0 < ns.flood_from < ns.nodes):
+            ap.error(f"--flood-from {ns.flood_from} outside "
+                     f"1..{ns.nodes - 1} (node 0 is the oracle anchor)")
+        if ns.campaign and ns.campaign != "roleflood":
+            ap.error("--flood-node miner IS the roleflood campaign — "
+                     "it cannot combine with a different --campaign")
+    else:
+        try:
+            flood_node = int(ns.flood_node)
+        except ValueError:
+            ap.error(f"--flood-node must be an id or `miner`, got "
+                     f"{ns.flood_node!r}")
+        if ns.flood and not (0 <= flood_node < ns.nodes):
+            ap.error(f"--flood-node {flood_node} outside "
+                     f"0..{ns.nodes - 1}")
     if ns.slow_node >= ns.nodes:
         # a typo'd id would silently run a homogeneous cluster labeled
         # as a straggler scenario (slow_profile returns NO_SLOW outside
         # the id space) — refuse loudly like --flood-node
         ap.error(f"--slow-node {ns.slow_node} outside 0..{ns.nodes - 1}")
+    if ns.campaign and not (ns.campaign_node == -1
+                            or 0 < ns.campaign_node < ns.nodes):
+        # same failure mode as --slow-node: attacker_ids silently drops
+        # out-of-range pins, so a typo'd id would run an honest cluster
+        # labeled as an attack scenario (node 0 is the oracle anchor)
+        ap.error(f"--campaign-node {ns.campaign_node} outside "
+                 f"1..{ns.nodes - 1}")
+    if flood_at_miner and ns.campaign_node != -1:
+        # the sentinel pins the flooder via --flood-from; silently
+        # overriding an explicit --campaign-node would arm a DIFFERENT
+        # attacker than the one the user named
+        ap.error("--flood-node miner pins its flooder via --flood-from;"
+                 " it cannot combine with --campaign-node")
+    if ns.campaign and not _adversary.CampaignPlan(
+            campaign=ns.campaign, attackers=ns.campaign_attackers,
+            attacker_node=ns.campaign_node).attacker_ids(ns.nodes):
+        # an armed campaign whose draw is EMPTY would run an honest (or
+        # merely static) cluster labeled as the attack scenario — the
+        # exact mislabeling ISSUE 14's acceptance forbids ("a
+        # static-poisoner rerun labeled adaptive is not" acceptable)
+        ap.error(f"--campaign {ns.campaign} drew no attackers: raise "
+                 f"--campaign-attackers (fraction of {ns.nodes} top "
+                 "ids) or pin --campaign-node")
+
+    # campaign plane (docs/ADVERSARY.md): an explicit --campaign, or the
+    # --flood-node miner sentinel (role-aware targeted flood pinned on
+    # --flood-from). One plan on EVERY peer's config — the plane arms
+    # itself only on the drawn attacker ids, so honest peers stay on the
+    # seed path by construction.
+    if flood_at_miner:
+        camp_plan = _adversary.CampaignPlan(
+            campaign="roleflood", seed=ns.campaign_seed,
+            attackers=ns.campaign_attackers,
+            attacker_node=ns.flood_from,
+            flood=ns.flood or ns.campaign_flood)
+    else:
+        camp_plan = _adversary.CampaignPlan(
+            campaign=ns.campaign, seed=ns.campaign_seed,
+            attackers=ns.campaign_attackers,
+            attacker_node=ns.campaign_node,
+            flood=ns.campaign_flood,
+            recycle_period=ns.campaign_recycle_period,
+            recycle_down=ns.campaign_recycle_down)
+    if camp_plan.campaign == "sybil" and not camp_plan.recycle_schedule(
+            ns.nodes, ns.rounds, protocol_seed=ns.seed):
+        # an armed sybil campaign with no recycle inside the run is the
+        # same mislabeling as an empty attacker draw: a static cluster
+        # reported as an identity-recycling attack
+        ap.error(f"--campaign sybil schedules no recycles in --rounds "
+                 f"{ns.rounds}: raise --rounds above "
+                 f"--campaign-recycle-period ({ns.campaign_recycle_period})"
+                 " or shrink the period")
 
     import jax
 
@@ -241,7 +369,15 @@ def main(argv=None) -> int:
                            churn=ns.churn, churn_period=ns.churn_period,
                            churn_down=ns.churn_down,
                            churn_seed=ns.churn_seed, **slow_kw)
-    admit = bool(ns.flood) if ns.admission < 0 else bool(ns.admission)
+    # default: the admission plane arms whenever ANY flood runs — the
+    # static storm (--flood) or a roleflood campaign (incl. the
+    # --flood-node miner sentinel, which floods at --campaign-flood
+    # without --flood being set); an unshedded flood scenario must be
+    # an explicit --admission 0 choice, never a silent default
+    flooding_somehow = bool(ns.flood) or (
+        camp_plan.enabled and camp_plan.campaign == "roleflood"
+        and camp_plan.flood > 0)
+    admit = flooding_somehow if ns.admission < 0 else bool(ns.admission)
     # harness-scaled budgets: a 4-node fast-timeout loopback cluster's
     # honest rate is well under 1 frame/s/peer/class, so these rates are
     # still ~10x headroom for honest traffic — while a 50x flood burst
@@ -266,20 +402,27 @@ def main(argv=None) -> int:
     if ns.overlay:
         overlay_group = ns.overlay_group or max(2, ns.nodes // 2)
 
+    defense = _Defense(ns.defense)
+    verification = bool(ns.verification) or defense != _Defense.NONE
+
     def cfg(i):
-        flooding = ns.flood > 0 and i == ns.flood_node
+        flooding = ns.flood > 0 and not flood_at_miner and i == flood_node
         return BiscottiConfig(
             node_id=i, num_nodes=ns.nodes, dataset=ns.dataset,
-            base_port=ns.base_port, num_verifiers=1, num_miners=1,
+            base_port=ns.base_port, num_verifiers=ns.verifiers,
+            num_miners=1,
             num_noisers=1, secure_agg=bool(ns.secure_agg), noising=False,
-            verification=bool(ns.verification),
+            verification=verification, defense=defense,
+            poison_fraction=ns.poison,
             max_iterations=ns.rounds, convergence_error=0.0,
             sample_percent=1.0, batch_size=8, timeouts=fast,
+            seed=ns.seed,
             rpc_retries=ns.rpc_retries,
             breaker_threshold=ns.breaker_threshold,
             breaker_cooldown_s=ns.breaker_cooldown_s,
             fault_plan=flood_plan if flooding else plan,
             admission_plan=admission,
+            campaign_plan=camp_plan,
             snapshot_bootstrap=bool(ns.snapshot_bootstrap),
             adaptive_deadlines=bool(ns.adaptive_deadlines),
             # carried on EVERY peer's config — the `plan` peers and the
@@ -289,22 +432,35 @@ def main(argv=None) -> int:
             device_crypto=bool(ns.device_crypto),
             wire_codec=ns.codec)
 
-    if ns.churn > 0:
+    # the sybil campaign's identity recycling rides the same runner the
+    # churn plane uses — kills self-fire in the victims' round loops,
+    # the runner relaunches fresh incarnations
+    recycle_events = camp_plan.recycle_schedule(ns.nodes, ns.rounds,
+                                                protocol_seed=ns.seed)
+    made = {}
+
+    def make_agent(i):
+        a = PeerAgent(cfg(i))
+        made[i] = a  # latest incarnation; node 0 is never churned
+        return a
+
+    if ns.churn > 0 or recycle_events:
         from biscotti_tpu.runtime.membership import (ChurnRunner,
                                                      surviving_prefix_oracle)
 
-        schedule = plan.churn_schedule(ns.nodes, ns.rounds)
+        schedule = sorted(
+            plan.churn_schedule(ns.nodes, ns.rounds) + recycle_events,
+            key=lambda e: (e.round, e.node, e.kind))
 
         async def go():
-            runner = ChurnRunner(lambda i: PeerAgent(cfg(i)), ns.nodes,
-                                 schedule)
+            runner = ChurnRunner(make_agent, ns.nodes, schedule)
             return await runner.run(), runner.events_applied
 
         results, applied = asyncio.run(go())
         prefix_equal, common, real_blocks = surviving_prefix_oracle(results)
     else:
         async def go():
-            agents = [PeerAgent(cfg(i)) for i in range(ns.nodes)]
+            agents = [make_agent(i) for i in range(ns.nodes)]
             return await asyncio.gather(*(a.run() for a in agents))
 
         results = asyncio.run(go())
@@ -317,13 +473,39 @@ def main(argv=None) -> int:
     # construction
     cluster = cluster_table(results)
     report = {
-        "nodes": ns.nodes, "rounds": ns.rounds,
+        "nodes": ns.nodes, "rounds": ns.rounds, "seed": ns.seed,
         "wire_codec": ns.codec,
         "fault_plan": {"seed": plan.seed, "drop": plan.drop,
                        "delay": plan.delay, "delay_s": plan.delay_s,
                        "duplicate": plan.duplicate, "reset": plan.reset},
-        "flood": {"factor": ns.flood, "node": ns.flood_node}
-                 if ns.flood else None,
+        "flood": {"factor": (ns.flood or camp_plan.flood)
+                            if flood_at_miner else ns.flood,
+                  "node": "miner" if flood_at_miner else flood_node,
+                  **({"from": ns.flood_from} if flood_at_miner else {})}
+                 if (ns.flood or flood_at_miner) else None,
+        "poison": ns.poison or None,
+        "defense": defense.value,
+        # defense outcomes off the settled anchor ledger — the ONE
+        # verdict parser (tools/verdicts.py), same columns as the
+        # attack-matrix artifact, so a chaos replay of a matrix cell is
+        # comparable row-for-row
+        "defense_verdict": (_verdicts.cluster_defense_verdict(
+            results, ns.nodes, ns.poison,
+            anchor_blocks=made[0].chain.blocks)
+            if (ns.poison > 0 or camp_plan.enabled) else None),
+        # adversary-campaign readout (docs/ADVERSARY.md): the armed plan
+        # plus the cluster's merged action/target tallies and, for the
+        # sybil campaign, the recycle events the runner actually applied
+        # — built from the same telemetry the test suite asserts on
+        "campaign": ({
+            "name": camp_plan.campaign,
+            "seed": camp_plan.seed,
+            "attackers": sorted(camp_plan.attacker_ids(ns.nodes)),
+            "flood": camp_plan.flood,
+            "recycles_scheduled": [
+                [e.round, e.node, e.kind] for e in recycle_events],
+            **cluster["campaign"],
+        } if camp_plan.enabled else None),
         "churn": {"fraction": ns.churn, "seed": churn_seed,
                   "period": ns.churn_period, "down": ns.churn_down,
                   "events_applied": applied}

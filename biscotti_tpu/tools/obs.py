@@ -261,6 +261,25 @@ def merge_overlay(snaps: List[Dict]) -> Dict:
     return out
 
 
+def merge_campaign(snaps: List[Dict]) -> Dict:
+    """Merge the adversary-campaign readouts (docs/ADVERSARY.md): which
+    peers run which campaign, the summed action tallies, and the
+    per-target flood hit counts — the chaos report's `campaign` key and
+    the attack-matrix artifact read exactly this."""
+    out: Dict = {"active": [], "actions": {}, "targets_hit": {}}
+    for snap in snaps:
+        c = snap.get("campaign")
+        if not c:
+            continue
+        out["active"].append({"node": snap.get("node"),
+                              "campaign": c.get("campaign")})
+        for k, v in (c.get("actions") or {}).items():
+            out["actions"][k] = out["actions"].get(k, 0) + int(v)
+        for t, v in (c.get("targets_hit") or {}).items():
+            out["targets_hit"][t] = out["targets_hit"].get(t, 0) + int(v)
+    return out
+
+
 def merge_snapshots(snaps: List[Dict]) -> Dict:
     """One cluster table from per-peer telemetry snapshots (the schema
     `PeerAgent.telemetry_snapshot()` / the `Metrics` RPC serve)."""
@@ -333,6 +352,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "counters": counters,
         "wire": wire,
         "overlay": merge_overlay(snaps),
+        "campaign": merge_campaign(snaps),
         "admission": merge_admission(snaps),
         "stragglers": merge_stragglers(snaps),
         "hives": merge_hives(snaps),
@@ -431,6 +451,18 @@ def format_table(merged: Dict) -> str:
                       + (f"   slow [{slow}]" if slow else "")
                       + (f"   deadlines [{dl}]" if dl else "")
                       + f"   [{strag['adaptive_peers']} peers adaptive]"]
+    camp = merged.get("campaign") or {}
+    if camp.get("active"):
+        who = ", ".join(f"{a['node']}:{a['campaign']}"
+                        for a in camp["active"])
+        acts = ", ".join(f"{k}={v}" for k, v in
+                         sorted(camp["actions"].items()))
+        hits = ", ".join(f"→{t}:{v}" for t, v in
+                         sorted(camp["targets_hit"].items(),
+                                key=lambda kv: -kv[1])[:6])
+        lines += ["", f"campaign: [{who}]"
+                      + (f"   actions [{acts}]" if acts else "")
+                      + (f"   flood hits [{hits}]" if hits else "")]
     hives = merged.get("hives") or {}
     if hives:
         lines += ["", f"{'hive':<16} {'peers':>6} {'scraped':>8} "

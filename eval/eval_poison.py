@@ -42,19 +42,19 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import statistics
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# one verdict/outcome helper shared with the live attack matrix
+# (eval/eval_attack_matrix.py) and the chaos harnesses — aggregation and
+# the separation criterion must not fork between the sim sweep and the
+# live matrix (tools/verdicts.py)
+from biscotti_tpu.tools.verdicts import (agg_mean_std as _agg,  # noqa: E402
+                                         separates)
+
 POISON_FRACTIONS = [0.0, 0.10, 0.20, 0.30, 0.40]
-
-
-def _agg(vals):
-    m = statistics.fmean(vals)
-    s = statistics.stdev(vals) if len(vals) > 1 else 0.0
-    return round(m, 4), round(s, 4)
 
 
 def main(argv=None) -> int:
@@ -240,15 +240,16 @@ def main(argv=None) -> int:
     else:
         g30, n30 = cell(0.30, gate_name), cell(0.30, "NONE")
         clean = cell(0.0, "NONE")
-        margin = (g30["attack_rate_std"] + n30["attack_rate_std"]
-                  if len(seeds) > 1 else 0.0)
-        separates = (n30["attack_rate"] - g30["attack_rate"]) > margin
+        sep, margin = separates(
+            g30["attack_rate"], g30["attack_rate_std"],
+            n30["attack_rate"], n30["attack_rate_std"],
+            n_samples=len(seeds))
         # diagnostic only (no longer a silent gate bypass): on robust
         # tasks the undefended attack barely moves the metric and
         # separation is unmeasurable — such runs should pass --no-gate
         attack_bites = (n30["attack_rate"] - clean["attack_rate"]) >= 0.10
         gate.update({
-            "ok": separates, "separates": separates,
+            "ok": sep, "separates": sep,
             "separation_margin_required": round(margin, 4),
             "attack_bites": attack_bites,
             "at_ref_scale": args.nodes >= 50,
@@ -262,7 +263,7 @@ def main(argv=None) -> int:
                                    "@dir stress, or attack-robust task)")
             gate_ok = True
         else:
-            gate_ok = separates
+            gate_ok = sep
     summary["gate"] = gate
     with open(os.path.join(args.out, f"{stem}.json"), "w") as f:
         json.dump(summary, f, indent=1)

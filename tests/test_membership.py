@@ -674,14 +674,17 @@ def test_churn_acceptance_20pct_turnover_defense_intact():
                     snapshot_bootstrap=snap, snapshot_tail=4)
 
     def accepted_poisoned(anchor_agent):
-        from biscotti_tpu.parallel.sim import _poisoned_ids
+        # ONE verdict parser (tools/verdicts.py), shared with the sim
+        # sweep and the live attack matrix — no second hand-rolled
+        # ledger read here
+        from biscotti_tpu.tools.verdicts import (chain_defense_verdict,
+                                                 poisoned_ids)
 
-        poisoned = _poisoned_ids(n, 0.3)
+        poisoned = poisoned_ids(n, 0.3)
         assert poisoned, "poison operating point empty"
-        return {u.source_id
-                for b in anchor_agent.chain.blocks
-                for u in b.data.deltas
-                if u.accepted and u.source_id in poisoned}
+        verdict = chain_defense_verdict(anchor_agent.chain.blocks,
+                                        poisoned)
+        return set(verdict["accepted_poisoned"])
 
     async def churn_run():
         made = {}
