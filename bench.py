@@ -712,8 +712,12 @@ def bench_attack_matrix(budget_s: float = 600.0):
     # enforced BETWEEN cells like every sibling bench entry
     ns = SimpleNamespace(nodes=10, verifiers=3, rounds=8, seed=11,
                          poison=0.3, flood=30, dataset="mnist@dir0.3")
+    # hug x ENSEMBLE is THE tentpole guard (ISSUE 16): the adaptive
+    # defense plane's claim is exactly this cell flipping to survived —
+    # a future PR that un-survives the hugger fails the bench_diff gate
     cells = [("static", Defense.KRUM), ("hug", Defense.KRUM),
-             ("static", Defense.FOOLSGOLD), ("hug", Defense.FOOLSGOLD)]
+             ("static", Defense.FOOLSGOLD), ("hug", Defense.FOOLSGOLD),
+             ("hug", Defense.ENSEMBLE)]
     out = {"complete": True}
     deadline = time.time() + budget_s
     port = 14190

@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 # stdlib-only modules (hash-derived decisions, breaker state machine,
 # token buckets): safe to import here without dragging the asyncio
 # runtime into config users
+from biscotti_tpu.ops.trust import TrustPlan
 from biscotti_tpu.runtime.admission import AdmissionPlan
 from biscotti_tpu.runtime.adversary import CAMPAIGNS, CampaignPlan
 from biscotti_tpu.runtime.faults import SLOW_PRESETS, FaultPlan
@@ -57,6 +58,17 @@ class Defense(str, enum.Enum):
     # its demonstrated win is the noising-off defense-geometry operating
     # point (see ops/robust_agg.py OPERATING POINT note)
     FOOLSGOLD = "FOOLSGOLD"
+    # Adaptive defense plane (ops/trust.py, docs/DEFENSES.md): the
+    # cross-round TrustLedger composes Krum geometry, keep-set-calibrated
+    # pairwise similarity, a magnitude band, a temporal-drift scorer fed
+    # by the committed chain's accept/reject walk, and a stake-weighted
+    # slow-trust ramp into ONE accept mask with hysteresis. Still a
+    # verifier accept-mask defense — rejection mechanics are exact parity
+    # with KRUM/MULTIKRUM (worker declines, no record lands), so it
+    # composes with secure aggregation; the evidence trail is the verdict
+    # stream + trust snapshot. Built to close PR 14's measured hugger gap
+    # (the threshold-walking poisoner that defeats memoryless Krum).
+    ENSEMBLE = "ENSEMBLE"
 
 
 @dataclass
@@ -213,6 +225,17 @@ class BiscottiConfig:
     # (biscotti_campaign_actions_total). Default = disabled: the seed
     # schedule, bit-identical (guarded by tests/test_adversary.py).
     campaign_plan: CampaignPlan = field(default_factory=CampaignPlan)
+    # adaptive defense plane (ops/trust.py, docs/DEFENSES.md): armed only
+    # when defense == ENSEMBLE — the plan knobs calibrate the ensemble
+    # vetoes, the drift scorer, hysteresis and the slow-trust ramp. With
+    # any other defense no TrustLedger is constructed and verdicts are
+    # bit-identical to the seed (guarded by tests/test_trust.py).
+    trust_plan: TrustPlan = field(default_factory=TrustPlan)
+    # FoolsGold minimum mutually-similar cluster size for a rejection
+    # (ops/robust_agg.py small-N fix): 3 stops N=10 honest pools from
+    # mass-flagging accidental honest pairs; 1 restores pre-PR-16
+    # pair-level rejection
+    fg_min_cluster: int = 3
 
     # --- straggler-tolerance plane (runtime/stragglers.py,
     # docs/STRAGGLERS.md) ---
@@ -430,6 +453,22 @@ class BiscottiConfig:
                 "campaigns adapt to the VRF election and chain state, "
                 "which the FedSys baseline does not have "
                 "(docs/ADVERSARY.md)")
+        # adaptive defense plane: a nonsensical knob must fail at
+        # construction, not on the first verifier decision; the ledger's
+        # drift scorer and slow-trust ramp read the committed chain, so
+        # fedsys (no chain, no election) cannot host it
+        self.trust_plan.validate()
+        if self.defense == Defense.ENSEMBLE and self.fedsys:
+            raise ValueError(
+                "defense=ENSEMBLE is incompatible with fedsys mode: the "
+                "TrustLedger's drift scorer and slow-trust ramp are "
+                "derived from the committed chain's accept/reject walk, "
+                "which the FedSys baseline does not have "
+                "(docs/DEFENSES.md)")
+        if self.fg_min_cluster < 1:
+            raise ValueError(
+                f"fg_min_cluster={self.fg_min_cluster} must be >= 1 "
+                "(1 = pre-fix pair-level FoolsGold rejection)")
         if not (0.0 <= self.fault_plan.churn < 1.0):
             raise ValueError(
                 f"fault_plan.churn={self.fault_plan.churn} must be in "
@@ -729,6 +768,49 @@ class BiscottiConfig:
                        help="hug: per-attacker decorrelation jitter as "
                             "a fraction of the observed honest step "
                             "norm")
+        p.add_argument("--fg-min-cluster", type=int,
+                       default=BiscottiConfig.fg_min_cluster,
+                       help="FoolsGold: minimum mutually-similar cluster "
+                            "size for a rejection (small-N fix; 1 = "
+                            "pre-fix pair-level behavior)")
+        p.add_argument("--trust-geo-ratio", type=float,
+                       default=TrustPlan.geo_ratio,
+                       help="ENSEMBLE: geometry veto fires when a Krum "
+                            "score exceeds ratio x the worst KEPT score")
+        p.add_argument("--trust-sim-margin", type=float,
+                       default=TrustPlan.sim_margin,
+                       help="ENSEMBLE: similarity veto bar = kept-pair "
+                            "cosine median + max(margin, mad_mult x MAD)")
+        p.add_argument("--trust-mag-band", type=float,
+                       default=TrustPlan.mag_band,
+                       help="ENSEMBLE: magnitude veto fires outside "
+                            "[median/band, median x band] of kept norms")
+        p.add_argument("--trust-drift-hi", type=float,
+                       default=TrustPlan.drift_hi,
+                       help="ENSEMBLE: drift score that sets the flag "
+                            "(Schmitt trigger upper threshold)")
+        p.add_argument("--trust-drift-lo", type=float,
+                       default=TrustPlan.drift_lo,
+                       help="ENSEMBLE: drift score that clears the flag "
+                            "(Schmitt trigger lower threshold)")
+        p.add_argument("--trust-hold", type=int,
+                       default=TrustPlan.hold_rounds,
+                       help="ENSEMBLE: rounds a veto keeps rejecting a "
+                            "peer after the last scorer vote (hysteresis)")
+        p.add_argument("--trust-ramp-rounds", type=int,
+                       default=TrustPlan.ramp_rounds,
+                       help="ENSEMBLE: accepted on-chain blocks a fresh/"
+                            "recycled identity needs to reach full "
+                            "slow-trust weight (0 disables the ramp)")
+        p.add_argument("--trust-ramp-floor", type=float,
+                       default=TrustPlan.ramp_floor,
+                       help="ENSEMBLE: slow-trust weight of a zero-"
+                            "history identity (duty-cycle admission)")
+        p.add_argument("--trust-absence-reset", type=int,
+                       default=TrustPlan.absence_reset,
+                       help="ENSEMBLE: consecutive eligible-absent real "
+                            "blocks that restart an identity's ramp "
+                            "(catches churn-recycled sybils)")
         p.add_argument("--admission", type=int,
                        default=int(AdmissionPlan.enabled),
                        help="1 arms the overload-governance plane: "
@@ -948,6 +1030,25 @@ class BiscottiConfig:
                 hug_jitter=getattr(ns, "campaign_hug_jitter",
                                    CampaignPlan.hug_jitter),
             ),
+            trust_plan=TrustPlan(
+                geo_ratio=getattr(ns, "trust_geo_ratio",
+                                  TrustPlan.geo_ratio),
+                sim_margin=getattr(ns, "trust_sim_margin",
+                                   TrustPlan.sim_margin),
+                mag_band=getattr(ns, "trust_mag_band", TrustPlan.mag_band),
+                drift_hi=getattr(ns, "trust_drift_hi", TrustPlan.drift_hi),
+                drift_lo=getattr(ns, "trust_drift_lo", TrustPlan.drift_lo),
+                hold_rounds=getattr(ns, "trust_hold",
+                                    TrustPlan.hold_rounds),
+                ramp_rounds=getattr(ns, "trust_ramp_rounds",
+                                    TrustPlan.ramp_rounds),
+                ramp_floor=getattr(ns, "trust_ramp_floor",
+                                   TrustPlan.ramp_floor),
+                absence_reset=getattr(ns, "trust_absence_reset",
+                                      TrustPlan.absence_reset),
+            ),
+            fg_min_cluster=getattr(ns, "fg_min_cluster",
+                                   cls.fg_min_cluster),
             admission_plan=AdmissionPlan(
                 enabled=bool(getattr(ns, "admission",
                                      AdmissionPlan.enabled)),

@@ -163,10 +163,12 @@ def max_mutual_cosine(updates: jax.Array) -> jax.Array:
     return jnp.max(_cosine_matrix(updates), axis=1)
 
 
-@jax.jit
-def foolsgold_accept_mask(updates: jax.Array) -> jax.Array:
+@partial(jax.jit, static_argnames=("min_cluster",))
+def foolsgold_accept_mask(updates: jax.Array,
+                          min_cluster: int = 3) -> jax.Array:
     """Binary accept mask: reject clients whose max mutual cosine is a
-    robust (median + 3·MAD) upper outlier of the round's v-distribution.
+    robust (median + 3·MAD) upper outlier of the round's v-distribution
+    AND who sit in a mutually-similar cluster of >= `min_cluster`.
 
     Deviation from the paper, on purpose: FoolsGold's logit-clipped
     weights assume near-duplicate sybils (cos → 1) and saturate to 1 for
@@ -180,7 +182,22 @@ def foolsgold_accept_mask(updates: jax.Array) -> jax.Array:
     needs for additive secure aggregation and block-level stake debits.
     Honest-majority assumption: median(v) tracks the honest level. At
     least half the clients are always kept (MAD floor), so a degenerate
-    uniform round rejects no one."""
+    uniform round rejects no one.
+
+    Small-N fix (PR 16): with pools of ~6 the outlier test alone
+    mass-flags honest peers — an honest pair that happens to share a
+    minibatch direction lands above the bar and gets stake-starved round
+    after round. A sybil attack is by definition a *coordinated cluster*,
+    so the rejection additionally requires the flagged client to have at
+    least `min_cluster - 1` partners that are themselves flagged and
+    mutually similar at the same threshold. `min_cluster=1` restores the
+    pre-fix behaviour; the 100-node eval's 30-strong poison cluster is
+    far above any sensible setting. Trade-off, documented in
+    docs/ADVERSARY.md: sub-`min_cluster` poison cliques (e.g. a pair)
+    now pass this kernel — the ENSEMBLE defense's keep-set-calibrated
+    similarity veto covers that case without a cluster floor, because
+    its bar is anchored on the Krum-kept set rather than the pool
+    median."""
     v = max_mutual_cosine(updates)
     med = jnp.median(v)
     mad = jnp.median(jnp.abs(v - med))
@@ -191,4 +208,17 @@ def foolsgold_accept_mask(updates: jax.Array) -> jax.Array:
     # round and stake-starved for cosine noise far below any real sybil
     # signal (poison-poison cos ≈ 0.3 vs honest ≈ 0.04; ADVICE r5)
     thresh = med + jnp.maximum(3.0 * mad, 0.05)
-    return v <= thresh
+    flagged = v > thresh
+    if min_cluster > 1:
+        # cluster size = self + flagged partners whose pairwise cosine is
+        # commensurate with the pair's own sybil statistic (>= 80% of the
+        # larger v). Gating on `thresh` instead would let an honest
+        # bystander that merely clears the outlier test inflate a real
+        # pair into a "cluster" — coordination means the partners are
+        # each other's similarity signal, not just any two outliers.
+        cs = _cosine_matrix(updates)
+        vmax = jnp.maximum(v[:, None], v[None, :])
+        partners = (cs >= 0.8 * vmax) & flagged[None, :] & flagged[:, None]
+        csize = jnp.sum(partners, axis=1) + 1
+        flagged = flagged & (csize >= min_cluster)
+    return ~flagged

@@ -48,6 +48,7 @@ from biscotti_tpu.ledger.block import Block, BlockData, Update
 from biscotti_tpu.ledger.chain import Blockchain, ChainInvariantError
 from biscotti_tpu.models.trainer import Trainer
 from biscotti_tpu.ops import secretshare as ss
+from biscotti_tpu.ops import trust as trustlib
 from biscotti_tpu.parallel import roles as R
 from biscotti_tpu.parallel.sim import _poisoned_ids
 from biscotti_tpu.runtime import admission as adm
@@ -540,6 +541,19 @@ class PeerAgent:
             self._churn_kills = frozenset(
                 self._churn_kills
                 | self.campaign.kill_rounds(cfg.max_iterations))
+        # adaptive defense plane (ops/trust.py, docs/DEFENSES.md): the
+        # cross-round TrustLedger is constructed ONLY under
+        # --defense ENSEMBLE — every other defense runs the seed verdict
+        # path with no ledger object at all (bit-identity guarded by
+        # tests/test_trust.py). Independently of the ledger, every
+        # verifier records a bounded per-round verdict stream
+        # (accept/reject walk + observed magnitudes) so attack-matrix
+        # cells carry the hugger's walk as replayable evidence even for
+        # the defenses it defeats.
+        self.trust: Optional[trustlib.TrustLedger] = (
+            trustlib.TrustLedger(cfg.trust_plan, cfg.num_nodes)
+            if cfg.defense == Defense.ENSEMBLE else None)
+        self._verdict_stream: List[Dict] = []
 
     # ------------------------------------------------------------ utilities
 
@@ -623,6 +637,13 @@ class PeerAgent:
         for ph, row in self.deadlines.snapshot()["phases"].items():
             if "deadline_s" in row:
                 dl.set(row["deadline_s"], phase=ph)
+        # adaptive defense plane (docs/DEFENSES.md): this verifier's
+        # per-peer ledger scores — slow-trust weight x (1 − drift score),
+        # zeroed while a peer is flagged or held
+        if self.trust is not None:
+            tg = reg.gauge(trustlib.TRUST_METRIC, trustlib.TRUST_HELP)
+            for pid, score in self.trust.trust_scores().items():
+                tg.set(score, peer=str(pid))
 
     def _release_device_hooks(self) -> None:
         """Teardown half of the device-crypto arming: drop the
@@ -732,6 +753,21 @@ class PeerAgent:
             # execution tallies.
             **({"campaign": self.campaign.snapshot()}
                if self.campaign is not None else {}),
+            # adaptive-defense readout (docs/DEFENSES.md): present only
+            # when the ENSEMBLE ledger is armed or this peer recorded
+            # verifier verdicts, so every other snapshot schema stays
+            # byte-identical to the seed. `stream` is the per-round
+            # accept/reject walk (+ observed magnitudes and, under
+            # ENSEMBLE, per-peer scorer votes) that attack-matrix cell
+            # rows and obs.merge_trust read; `ledger` is the TrustLedger
+            # state the layout-invariance tests compare.
+            **({"trust": {
+                "defense": self.cfg.defense.value,
+                "stream": list(self._verdict_stream),
+                **({"ledger": self.trust.snapshot()}
+                   if self.trust is not None else {}),
+            }} if (self.trust is not None or self._verdict_stream)
+               else {}),
         }
 
     async def _h_metrics(self, meta, arrays):
@@ -2755,6 +2791,8 @@ class PeerAgent:
             pool = sorted(rng.sample(pool, self.cfg.krum_sample_size),
                           key=lambda u: u.source_id)
         accepted: Set[int] = set()
+        votes_detail: Optional[List[List[str]]] = None
+        vecs: Optional[np.ndarray] = None
         if pool:
             import jax.numpy as jnp
 
@@ -2779,7 +2817,11 @@ class PeerAgent:
                 from biscotti_tpu.ops.robust_agg import foolsgold_accept_mask
 
                 mask = np.asarray(foolsgold_accept_mask(
-                    jnp.asarray(vecs, jnp.float32)))
+                    jnp.asarray(vecs, jnp.float32),
+                    self.cfg.fg_min_cluster))
+            elif self.cfg.defense == Defense.ENSEMBLE and len(pool) > 2:
+                mask, votes_detail = self._ensemble_mask(
+                    st.iteration, pool, vecs)
             elif self.cfg.defense == Defense.RONI:
                 mask = np.asarray(roni_accept_mask(
                     self.trainer.model,
@@ -2800,7 +2842,106 @@ class PeerAgent:
                          if u.source_id in poisoners}
         self._trace("defense_decided", pool=len(pool),
                     accepted=sorted(accepted))
+        if pool:
+            self._verdict_record(st.iteration, pool, vecs, accepted,
+                                 votes_detail)
         st.krum_decision.set_result(accepted)
+
+    def _verdict_record(self, it: int, pool: List[Update],
+                        vecs: np.ndarray, accepted: Set[int],
+                        votes: Optional[List[List[str]]]) -> None:
+        """Append one verdict-stream row: this verifier's per-peer
+        accept/reject walk plus the observed delta magnitudes — the
+        replayable artifact evidence behind every attack-matrix cell
+        (docs/DEFENSES.md §Evidence). Recorded for EVERY defense decision
+        so the hugger's scale walk is visible in the cells it wins, not
+        only where ENSEMBLE suppresses it. Bounded by
+        trust_plan.stream_cap; ENSEMBLE rows also carry per-peer scorer
+        votes."""
+        if len(self._verdict_stream) >= self.cfg.trust_plan.stream_cap:
+            return
+        norms = np.linalg.norm(np.asarray(vecs, np.float64), axis=1)
+        row: Dict = {
+            "it": it,
+            "src": [u.source_id for u in pool],
+            "norm": [round(float(x), 5) for x in norms],
+            "accept": [int(u.source_id in accepted) for u in pool],
+        }
+        if votes is not None:
+            row["votes"] = votes
+        self._verdict_stream.append(row)
+
+    def _trust_sync_chain(self) -> None:
+        """Fold newly-settled real blocks into the TrustLedger's chain
+        walk. Each block's electorate is re-derived from its predecessor
+        (the same common coin every peer runs), so eligibility — and
+        therefore the absence-means-rejected inference, the same one the
+        hug campaign itself runs on — is a pure function of the committed
+        chain. A pruned/unknown predecessor yields an unknown electorate
+        and that block contributes no absence signal."""
+        for blk in self.chain.blocks:
+            if blk.iteration < 0 or blk.iteration <= self.trust.synced_it:
+                continue
+            records = {u.source_id: bool(u.accepted)
+                       for u in blk.data.deltas}
+            committee: Optional[Set[int]] = None
+            prev = self.chain.get_block(blk.iteration - 1)
+            if prev is not None:
+                try:
+                    vs, ms = R.elect_committees(
+                        dict(prev.stake_map), prev.hash,
+                        self.cfg.num_verifiers, self.cfg.num_miners,
+                        self.cfg.num_nodes)
+                    committee = set(vs) | set(ms)
+                except ValueError:
+                    committee = None
+            self.trust.sync_block(blk.iteration, records, committee)
+
+    def _ensemble_mask(self, it: int, pool: List[Update],
+                       vecs: np.ndarray,
+                       ) -> Tuple[np.ndarray, List[List[str]]]:
+        """ENSEMBLE defense decision (ops/trust.py, docs/DEFENSES.md):
+        sync the ledger against the committed chain, compute the
+        geometry/similarity inputs (Krum scores + keep mask on device,
+        cosine matrix and kept-centroid residuals in float64 host math so
+        the ledger's decision is layout-deterministic), then let the
+        TrustLedger compose the vetoes into one accept mask."""
+        import jax.numpy as jnp
+
+        from biscotti_tpu.ops.krum import (default_num_adversaries,
+                                           krum_accept_mask, krum_scores)
+
+        self._trust_sync_chain()
+        x32 = jnp.asarray(vecs, jnp.float32)
+        f = default_num_adversaries(len(pool))
+        scores = [float(s) for s in np.asarray(krum_scores(x32, f))]
+        keep = [bool(b) for b in np.asarray(krum_accept_mask(x32, f))]
+        v64 = np.asarray(vecs, np.float64)
+        norms = np.linalg.norm(v64, axis=1)
+        unit = v64 / np.maximum(norms, 1e-12)[:, None]
+        cos = unit @ unit.T
+        np.fill_diagonal(cos, -1.0)
+        kept_rows = v64[np.asarray(keep)] if any(keep) else v64
+        centroid = kept_rows.mean(axis=0)
+        residuals = np.linalg.norm(v64 - centroid[None, :], axis=1)
+        ids = [u.source_id for u in pool]
+        accepts, votes, detail = self.trust.decide(
+            it, ids, [float(n) for n in norms],
+            [float(r) for r in residuals], scores, keep, cos.tolist())
+        if self.tele.enabled:
+            ctr = self.tele.registry.counter(trustlib.VOTES_METRIC,
+                                             trustlib.VOTES_HELP)
+            for vlist, ok in zip(votes, accepts):
+                for scorer in vlist:
+                    ctr.inc(scorer=scorer, vote="reject")
+                ctr.inc(scorer="ensemble",
+                        vote="accept" if ok else "reject")
+        self._trace("trust_decided", pool=len(pool),
+                    rejected=sorted(pid for pid, ok in zip(ids, accepts)
+                                    if not ok),
+                    sim_bar=round(detail["sim_bar"], 4),
+                    ref_geo=round(detail["ref_geo"], 6))
+        return np.asarray(accepts, dtype=bool), votes
 
     @staticmethod
     def _part_message(kind: str, iteration: int, nodes: Sequence[int]) -> bytes:

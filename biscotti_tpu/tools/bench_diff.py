@@ -35,7 +35,10 @@ from typing import Dict
 # read as a latency regression. `failed` / `accepted_poisoned_n` are the
 # attack-matrix survival bits (eval/eval_attack_matrix.py): a survived
 # cell flipping to failed (0 → 1) or a defense letting MORE poisoned
-# sources through must fail a bench diff loudly.
+# sources through must fail a bench diff loudly. The ENSEMBLE defense
+# row's guard cell (hug_ensemble, ISSUE 16) is covered by the same two
+# suffixes — bench.py emits its failed/accepted_poisoned_n under
+# attack_matrix.hug_ensemble, no new pattern needed.
 DEFAULT_REGRESS = (r"(?<!points_per)(_s|_seconds|_secs|round_total|"
                    r"bytes_per_round|_bytes|crypto_s|final_error|"
                    r"failed|accepted_poisoned_n)$")

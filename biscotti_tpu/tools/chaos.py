@@ -71,6 +71,7 @@ def tally_faults(results) -> Dict[str, int]:
 
 from biscotti_tpu.config import Defense as _Defense
 from biscotti_tpu.runtime import adversary as _adversary
+from biscotti_tpu.tools import obs as obs_mod
 from biscotti_tpu.tools import verdicts as _verdicts
 
 
@@ -506,6 +507,15 @@ def main(argv=None) -> int:
                 [e.round, e.node, e.kind] for e in recycle_events],
             **cluster["campaign"],
         } if camp_plan.enabled else None),
+        # adaptive-defense readout (docs/DEFENSES.md): merged verdict
+        # streams (per-verifier accept/reject walk + magnitudes + under
+        # ENSEMBLE the scorer votes) and the ledger rollup — the
+        # replayable counter-evidence to the campaign's schedule above.
+        # None when no verifier recorded a verdict (verification off).
+        "trust": (lambda t: t if t.get("verifiers") else None)(
+            obs_mod.merge_trust(
+                [r["telemetry"] for r in results if "telemetry" in r],
+                streams=True)),
         "churn": {"fraction": ns.churn, "seed": churn_seed,
                   "period": ns.churn_period, "down": ns.churn_down,
                   "events_applied": applied}

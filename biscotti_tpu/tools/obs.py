@@ -280,6 +280,43 @@ def merge_campaign(snaps: List[Dict]) -> Dict:
     return out
 
 
+def merge_trust(snaps: List[Dict], streams: bool = True) -> Dict:
+    """Merge the adaptive-defense readouts (docs/DEFENSES.md): which
+    verifiers recorded verdicts, the summed ensemble vote tallies, the
+    union of flagged peers and slow-trust resets, and (streams=True) the
+    full per-verifier verdict streams — the chaos report's `trust` key
+    and the attack-matrix cell rows read exactly this. streams=False
+    keeps the merged cluster table numeric-lean for bench artifacts."""
+    out: Dict = {"defense": "", "verifiers": [], "decisions": 0,
+                 "stream_rounds": 0, "votes": {}, "flagged": [],
+                 "resets": {}}
+    if streams:
+        out["streams"] = {}
+    flagged: set = set()
+    for snap in snaps:
+        t = snap.get("trust")
+        if not t:
+            continue
+        out["defense"] = t.get("defense") or out["defense"]
+        node = snap.get("node")
+        stream = t.get("stream") or []
+        if stream:
+            out["verifiers"].append(node)
+            out["stream_rounds"] += len(stream)
+            if streams:
+                out["streams"][str(node)] = stream
+        led = t.get("ledger") or {}
+        out["decisions"] += int(led.get("decisions", 0))
+        for k, v in (led.get("votes") or {}).items():
+            out["votes"][k] = out["votes"].get(k, 0) + int(v)
+        flagged.update(led.get("flagged") or [])
+        for pid, n in (led.get("resets") or {}).items():
+            out["resets"][pid] = out["resets"].get(pid, 0) + int(n)
+    out["flagged"] = sorted(flagged)
+    out["verifiers"].sort()
+    return out
+
+
 def merge_snapshots(snaps: List[Dict]) -> Dict:
     """One cluster table from per-peer telemetry snapshots (the schema
     `PeerAgent.telemetry_snapshot()` / the `Metrics` RPC serve)."""
@@ -353,6 +390,10 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "wire": wire,
         "overlay": merge_overlay(snaps),
         "campaign": merge_campaign(snaps),
+        # streams stay out of the merged cluster table (bench artifacts
+        # flatten its numeric leaves); the chaos report and the matrix
+        # cells merge them separately with streams=True
+        "trust": merge_trust(snaps, streams=False),
         "admission": merge_admission(snaps),
         "stragglers": merge_stragglers(snaps),
         "hives": merge_hives(snaps),
@@ -463,6 +504,18 @@ def format_table(merged: Dict) -> str:
         lines += ["", f"campaign: [{who}]"
                       + (f"   actions [{acts}]" if acts else "")
                       + (f"   flood hits [{hits}]" if hits else "")]
+    tr = merged.get("trust") or {}
+    if tr.get("verifiers"):
+        votes = ", ".join(f"{k}={v}" for k, v in
+                          sorted(tr["votes"].items()))
+        lines += ["", f"defense: {tr['defense'] or '-'}"
+                      f"   verdict rounds {tr['stream_rounds']} on "
+                      f"{len(tr['verifiers'])} verifiers"
+                      + (f"   votes [{votes}]" if votes else "")
+                      + (f"   flagged {tr['flagged']}"
+                         if tr["flagged"] else "")
+                      + (f"   ramp resets {tr['resets']}"
+                         if tr["resets"] else "")]
     hives = merged.get("hives") or {}
     if hives:
         lines += ["", f"{'hive':<16} {'peers':>6} {'scraped':>8} "

@@ -53,7 +53,7 @@ against regression by bench_diff, not by this exit code.
 
 Usage: python eval/eval_attack_matrix.py [--dataset digits] [--nodes 8]
            [--rounds 8] [--seed 11] [--poison 0.375]
-           [--defenses NONE,KRUM,MULTIKRUM,FOOLSGOLD] [--quick]
+           [--defenses NONE,KRUM,MULTIKRUM,FOOLSGOLD,ENSEMBLE] [--quick]
            [--out eval/results]
 """
 
@@ -166,6 +166,15 @@ def run_cell(campaign: str, defense, secure_agg: bool, port: int,
     results, applied = asyncio.run(go())
     anchor_blocks = made[0].chain.blocks
 
+    from biscotti_tpu.tools import obs
+
+    # per-verifier verdict streams (accept/reject walk + observed
+    # magnitudes + ENSEMBLE scorer votes): the replayable evidence that
+    # the hugger's scale walk happened — and, in the ENSEMBLE row, that
+    # it was suppressed — not just a final error number
+    trust = obs.merge_trust([r["telemetry"] for r in results
+                             if "telemetry" in r], streams=True)
+
     equal, settled, real = surviving_prefix_oracle(results)
     poison = 0.0 if campaign == "none" else ns.poison
     verdict = verdicts.cluster_defense_verdict(
@@ -182,6 +191,7 @@ def run_cell(campaign: str, defense, secure_agg: bool, port: int,
         "survived": survived, "failed": 0 if survived else 1,
         "accepted_poisoned_n": verdict.get("n_accepted_poisoned", 0),
         "verdict": verdict,
+        "trust": trust if trust.get("verifiers") else None,
         "recycles_applied": applied,
         "replay": _replay_cmd(campaign, defense, secure_agg, port, ns),
     }
@@ -239,7 +249,8 @@ def main(argv=None) -> int:
                          "ids {8,9} (the reference's top-ids formula)")
     ap.add_argument("--flood", type=int, default=30,
                     help="roleflood targeted replay factor")
-    ap.add_argument("--defenses", default="NONE,KRUM,MULTIKRUM,FOOLSGOLD")
+    ap.add_argument("--defenses",
+                    default="NONE,KRUM,MULTIKRUM,FOOLSGOLD,ENSEMBLE")
     ap.add_argument("--campaigns", default=",".join(CAMPAIGN_CELLS))
     ap.add_argument("--base-port", type=int, default=14400)
     ap.add_argument("--quick", action="store_true",
