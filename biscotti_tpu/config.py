@@ -369,6 +369,15 @@ class BiscottiConfig:
     # pre-tracing format (guarded by tests/test_tracing.py).
     trace: bool = False
 
+    # --- versioned protocol plane (runtime/protocol.py, docs/PROTOCOL.md) ---
+    # -1 = speak the current protocol version. 0..CURRENT pins the
+    # advertised feature set to a historical version row ("old build"
+    # emulation for the mixed-version matrix and rolling upgrades):
+    # the hello advertises only that row's features AND feature-gated
+    # messages introduced later (snapshot pulls, overlay relay frames)
+    # are refused exactly like the old build would — unknown method.
+    protocol_version: int = -1
+
     # --- ML hyperparameters (ref: ML/Pytorch/client.py:30,56; ML/code/logistic_model.py:8-13) ---
     learning_rate: float = 1e-3  # torch-path SGD lr (used by optimizer-step modes)
     logreg_alpha: float = 1e-2  # numpy-logreg step size α (ref: logistic_model.py:12)
@@ -504,6 +513,15 @@ class BiscottiConfig:
                 "trace=True requires telemetry=True (trace context and "
                 "span ids ride the flight recorder; "
                 "docs/OBSERVABILITY.md §Distributed tracing)")
+        # protocol plane: a pin outside the version table is a typo, not
+        # an old build — fail at construction (lazy import: the protocol
+        # registry pulls the codec table, which imports numpy)
+        from biscotti_tpu.runtime.protocol import CURRENT_VERSION
+        if not (-1 <= self.protocol_version <= CURRENT_VERSION):
+            raise ValueError(
+                f"protocol_version={self.protocol_version} must be -1 "
+                f"(current) or a historical row in [0, {CURRENT_VERSION}] "
+                "(runtime/protocol.py version table; docs/PROTOCOL.md)")
         # the overlay needs a real subtree to aggregate over — an armed
         # flag without a group would silently run the flat fan-out
         # labeled as an overlay run; refuse the dead configuration
@@ -922,6 +940,13 @@ class BiscottiConfig:
                             "(tools/trace_round stitches the cross-peer "
                             "round timeline; 0 = frames bit-identical "
                             "to the untraced format)")
+        p.add_argument("--protocol-version", type=int,
+                       default=BiscottiConfig.protocol_version,
+                       help="pin the advertised protocol feature set to "
+                            "a historical version row (old-build "
+                            "emulation for mixed-version clusters and "
+                            "rolling upgrades; -1 = current — "
+                            "docs/PROTOCOL.md)")
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
@@ -990,6 +1015,8 @@ class BiscottiConfig:
             recorder_ring=getattr(ns, "recorder_ring", cls.recorder_ring),
             recorder_batch=getattr(ns, "recorder_batch", cls.recorder_batch),
             trace=bool(getattr(ns, "trace", cls.trace)),
+            protocol_version=getattr(ns, "protocol_version",
+                                     cls.protocol_version),
             fault_plan=FaultPlan(
                 seed=getattr(ns, "fault_seed", FaultPlan.seed),
                 drop=getattr(ns, "fault_drop", FaultPlan.drop),

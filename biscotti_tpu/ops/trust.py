@@ -71,6 +71,12 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 TRUST_METRIC = "biscotti_trust_score"
 TRUST_HELP = ("per-peer trust score on this verifier's ledger: slow-trust "
               "weight x (1 - drift score), 0 while flagged/held")
+#: slow-trust duty-cycle credit ceiling: a long eligible-absent streak
+#: banks at most this many future passes, so a throttled identity cannot
+#: stockpile unbounded catch-up acceptance (credit is chain-derived —
+#: see TrustLedger.sync_block — and this cap keeps it bounded state)
+CREDIT_CAP = 2.0
+
 VOTES_METRIC = "biscotti_defense_votes_total"
 VOTES_HELP = ("ensemble defense votes by scorer (geometry/similarity/"
               "magnitude/drift/slow_trust/hold reject votes, plus the "
@@ -209,7 +215,11 @@ class _PeerState:
     absent_run: int = 0
     ramp: Optional[int] = None   # accepted-since-reset; None = graduated
     resets: int = 0
-    credit: float = 0.0          # slow-trust duty-cycle accumulator
+    #: slow-trust duty-cycle accumulator — CHAIN-derived (accrued and
+    #: consumed in sync_block, only read at decide time), so verifiers
+    #: that folded the same blocks agree on it regardless of which
+    #: rounds each happened to decide
+    credit: float = 0.0
     flagged: bool = False        # drift Schmitt state
     drift_score: float = 0.0
     hold: int = 0                # hysteresis hold-down counter
@@ -260,19 +270,46 @@ class TrustLedger:
                 st = self._peer(pid)
                 st.absent_run = 0
                 st.walk[iteration] = records[pid]
-                if records[pid] and ramp_on and st.ramp is not None:
-                    st.ramp += 1
-                    if st.ramp >= self.plan.ramp_rounds:
-                        st.ramp = None     # graduated: full weight
-                        st.credit = 0.0
+                if ramp_on and st.ramp is not None:
+                    if records[pid]:
+                        # slow-trust credit is CHAIN-derived (not
+                        # decide()-local): an accepted record is the
+                        # chain's own evidence that the duty-cycle gate
+                        # passed this round — consume the pass, advance
+                        # the ramp, then accrue the new weight. Every
+                        # verifier folding the same blocks holds the
+                        # same credit, so churned/rotated committees
+                        # issue UNANIMOUS slow_trust verdicts.
+                        st.credit = max(0.0, st.credit - 1.0)
+                        st.ramp += 1
+                        if st.ramp >= self.plan.ramp_rounds:
+                            st.ramp = None     # graduated: full weight
+                            st.credit = 0.0
+                        else:
+                            st.credit = min(
+                                CREDIT_CAP,
+                                st.credit + self.weight(pid))
+                    else:
+                        st.credit = min(CREDIT_CAP,
+                                        st.credit + self.weight(pid))
             elif committee is not None and pid not in committee:
                 st = self._peer(pid)
                 st.walk[iteration] = False
                 st.absent_run += 1
                 if ramp_on and st.absent_run == self.plan.absence_reset:
+                    # ramp restart: credit restarts at the floor too —
+                    # starting from zero would need 1/ramp_floor eligible
+                    # absences before the FIRST pass, and at the default
+                    # plan that streak re-triggers this very reset: a
+                    # fresh identity would starve in a reset loop
                     st.ramp = 0
-                    st.credit = 0.0
+                    st.credit = self.plan.ramp_floor
                     st.resets += 1
+                elif ramp_on and st.ramp is not None:
+                    # a throttled (or rejected) eligible round still
+                    # banks duty-cycle credit toward the next pass
+                    st.credit = min(CREDIT_CAP,
+                                    st.credit + self.weight(pid))
             # committee members (or unknown electorate): no signal
 
     # ------------------------------------------------------- slow-trust
@@ -299,7 +336,7 @@ class TrustLedger:
             st = self._peer(pid)
             if st.ramp is None and not any(st.walk.values()):
                 st.ramp = 0
-                st.credit = 0.0
+                st.credit = self.plan.ramp_floor
 
     def proven(self, pid: int) -> bool:
         """Whether a peer's recent chain walk has earned it out of the
@@ -454,13 +491,14 @@ class TrustLedger:
                 st.flagged = False
             if st.flagged:
                 votes.append("drift")
-            w = self.weight(pid)
-            if w < 1.0:
-                st.credit += w
-                if st.credit >= 1.0:
-                    st.credit -= 1.0
-                else:
-                    votes.append("slow_trust")
+            # slow-trust is READ-ONLY here: the credit accumulator is a
+            # pure function of the committed chain (sync_block), so any
+            # verifier — including one that just joined a churned
+            # committee mid-ramp — reaches the identical verdict. The
+            # pass itself is consumed by the accepted record the chain
+            # commits, not by this decision.
+            if self.weight(pid) < 1.0 and st.credit < 1.0:
+                votes.append("slow_trust")
             if votes:
                 # slow_trust is a duty-cycle throttle, not an accusation:
                 # arming the hold for it would starve a ramping identity

@@ -120,6 +120,29 @@ def rss_peak_bytes() -> int:
         return 0
 
 
+# Monitor samples retained for the drift window (seconds). Long enough
+# that allocator sawtooth averages out, short enough that a genuine leak
+# moves the gauge within one soak sampling interval (tools/soak.py).
+DRIFT_WINDOW_S = 120.0
+
+
+def drift(values: Sequence[float]) -> float:
+    """Windowed drift: median of the newest quarter of ``values`` minus
+    median of the oldest quarter.
+
+    A plain last-minus-first delta aliases on GC/allocator sawtooth and
+    on a single slow event-loop tick; quarter-medians keep a monotone
+    leak visible while one outlier sample stays invisible.  Returns 0
+    until there are at least 4 samples (one per quarter)."""
+    if len(values) < 4:
+        return 0.0
+    q = max(1, len(values) // 4)
+    import statistics
+
+    return float(statistics.median(values[-q:])
+                 - statistics.median(values[:q]))
+
+
 # --------------------------------------------------------------- transport
 
 
@@ -620,6 +643,11 @@ class Hive:
             "id": hive_id or f"pid{os.getpid()}",
             "peers": len(self.local_ids),
             "rss_bytes": 0, "rss_peak_bytes": 0, "loop_lag_s": 0.0,
+            # windowed deltas over DRIFT_WINDOW_S of monitor samples: a
+            # leak or creeping starvation shows as sustained positive
+            # drift long before the absolute gauges look alarming
+            # (tools/soak.py gates on these; docs/SOAK.md)
+            "rss_drift_bytes": 0, "loop_lag_drift_s": 0.0,
         }
         self.agents: List[PeerAgent] = []
         for pid in self.local_ids:
@@ -644,13 +672,23 @@ class Hive:
         VISIBLE (an overloaded hive's lag gauge climbs), not inferred
         from round-time anomalies."""
         loop = asyncio.get_running_loop()
+        samples: List[Tuple[float, int, float]] = []
         while True:
             t0 = loop.time()
             await asyncio.sleep(period)
-            self.info["loop_lag_s"] = round(
-                max(0.0, loop.time() - t0 - period), 4)
-            self.info["rss_bytes"] = rss_bytes()
+            now = loop.time()
+            lag = round(max(0.0, now - t0 - period), 4)
+            rss = rss_bytes()
+            self.info["loop_lag_s"] = lag
+            self.info["rss_bytes"] = rss
             self.info["rss_peak_bytes"] = rss_peak_bytes()
+            samples.append((now, rss, lag))
+            while samples and now - samples[0][0] > DRIFT_WINDOW_S:
+                samples.pop(0)
+            self.info["rss_drift_bytes"] = int(
+                drift([r for _, r, _ in samples]))
+            self.info["loop_lag_drift_s"] = round(
+                drift([l for _, _, l in samples]), 4)
 
     async def run(self) -> List[Dict]:
         mon = asyncio.get_running_loop().create_task(self._monitor())

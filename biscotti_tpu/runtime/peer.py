@@ -56,6 +56,7 @@ from biscotti_tpu.runtime import adversary
 from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
 from biscotti_tpu.runtime import overlay as ov
+from biscotti_tpu.runtime import protocol
 from biscotti_tpu.runtime import stragglers
 from biscotti_tpu.runtime.faults import CircuitOpenError
 from biscotti_tpu.runtime.rpc import BusyError, RPCError, StaleError
@@ -286,14 +287,21 @@ class PeerAgent:
         # the downcast grid (see _create_block), so the wire itself is
         # always bit-exact and all crypto survives compression.
         self.wire = wcodecs.get(cfg.wire_codec)
-        self.caps = wcodecs.capabilities(cfg.wire_codec)
-        if cfg.trace:
-            # distributed tracing: advertise the `trace` capability in
-            # the RegisterPeer hello — trace context is attached only
-            # toward peers that advertised it back, so legacy/untraced
-            # peers keep receiving byte-identical frames (negotiated
-            # exactly like the wire codecs above)
-            self.caps = frozenset(self.caps | {tracectx.TRACE_CAP})
+        # versioned protocol plane (runtime/protocol.py,
+        # docs/PROTOCOL.md): ONE advertised feature set for every
+        # negotiated family — codec stages, chunking, trace stamping,
+        # busy-status, snapshot bootstrap, overlay relay — derived from
+        # the config and (when --protocol-version pins an old row)
+        # capped to that historical version's features. Feature tokens
+        # ride the hello's existing `codecs` list: old builds ignore
+        # unknown tokens and codec negotiation is all-or-raw64 over the
+        # stages alone, so the extension is wire-compatible both ways.
+        self.caps = protocol.advertised(cfg)
+        # features we speak that a given peer's hello did not grant —
+        # re-derived at every hello so the readout tracks restarts;
+        # emission (feature_degraded trace + counter) deduped per
+        # observed set in _record_caps
+        self._degraded_seen: Dict[int, frozenset] = {}
         # hierarchical aggregation overlay (runtime/overlay.py,
         # docs/OVERLAY.md): the deterministic per-round tree this peer
         # routes bulk fan-out through. Inactive (seed-identical flat
@@ -433,6 +441,9 @@ class PeerAgent:
         # reply-codec capability set for the RPC server: callers request
         # a reply codec via `acodec`, granted iff inside OUR caps
         self.server.caps = self.caps
+        # a version pin predating the busy feature sheds with the old
+        # build's plain-error reply (no structured retryable status)
+        self.server.busy_status = protocol.BUSY in self.caps
         # distributed tracing: arm the transport seams' receiver-side
         # dispatch spans (rpc.RPCServer._dispatch + the hive loopback
         # dispatch both read server.telemetry); None keeps the seed
@@ -710,6 +721,12 @@ class PeerAgent:
             # lag) the obs CLI groups its per-host columns by. None for
             # a standalone agent.
             "hive": dict(self.hive_info) if self.hive_info else None,
+            # versioned-protocol readout (docs/PROTOCOL.md): the version
+            # this peer speaks (pinned or current), its advertised
+            # feature set, and the features currently degraded per peer
+            # — the mixed-version matrix and the soak harness read this
+            "protocol": protocol.snapshot(self.cfg, self.caps,
+                                          self._degraded_seen),
             # aggregation-overlay readout (docs/OVERLAY.md): tree shape
             # plus this peer's aggregated/relayed/fallback tallies — the
             # obs overlay table and the chaos report's `overlay` key
@@ -894,18 +911,24 @@ class PeerAgent:
         gossip round on the event loop)."""
         return self._addr_to_pid.get((host, port))
 
+    def _grant(self, pid: int) -> frozenset:
+        """The negotiated per-peer feature set: our advertised features
+        ∩ what `pid`'s hello advertised (raw64 floor; no hello yet =
+        assume a legacy build). Every per-peer feature decision — codec,
+        chunking, trace stamping, relay routing, snapshot donors —
+        consults this grant (runtime/protocol.py, docs/PROTOCOL.md)."""
+        return protocol.grant(self.caps, self.peer_caps.get(pid))
+
     def _wire_to(self, pid: int) -> Tuple[str, int]:
         """(codec, chunk_bytes) to use toward `pid`: the configured
-        pipeline when the peer advertised every stage in its hello,
-        else raw64/unchunked — the graceful fallback that keeps legacy
-        (or legacy-configured) peers interoperable."""
-        caps = self.peer_caps.get(pid)
-        if caps is None:
+        pipeline when the grant carries every stage, else raw64/
+        unchunked — the graceful fallback that keeps legacy (or
+        legacy-configured, or version-pinned) peers interoperable."""
+        if self.peer_caps.get(pid) is None:
             return wcodecs.RAW, 0
-        codec = wcodecs.negotiate(self.cfg.wire_codec, caps)
-        chunk = (self.cfg.wire_chunk_bytes
-                 if (wcodecs.CHUNK_CAP in caps
-                     and wcodecs.CHUNK_CAP in self.caps) else 0)
+        g = self._grant(pid)
+        codec = wcodecs.negotiate(self.cfg.wire_codec, g)
+        chunk = self.cfg.wire_chunk_bytes if wcodecs.CHUNK_CAP in g else 0
         return codec, chunk
 
     def _reply_codec_meta(self, pid: int) -> Dict[str, int]:
@@ -926,19 +949,31 @@ class PeerAgent:
         WE trace and the peer advertised the `trace` capability in its
         hello — the same all-or-nothing negotiation the wire codecs use,
         so legacy peers (and mixed clusters) get untouched frames."""
-        return (self.tele.trace
-                and tracectx.TRACE_CAP in (self.peer_caps.get(pid) or ()))
+        return self.tele.trace and protocol.TRACE in self._grant(pid)
 
     def _record_caps(self, pid: int, caps) -> None:
         """Record a peer's advertised capability set from a hello or a
-        hello reply. A hello WITHOUT a capability set resets the entry
-        to raw64-only: a peer that restarted on a legacy build must
-        stop receiving coded frames immediately, not keep the caps its
-        previous incarnation advertised."""
-        if isinstance(caps, (list, tuple)):
-            self.peer_caps[pid] = frozenset(str(c) for c in caps)
-        else:
-            self.peer_caps[pid] = wcodecs.RAW_CAPS
+        hello reply. The legacy-hello reset rule lives in ONE place —
+        protocol.normalize_hello: a hello WITHOUT a capability set
+        resets the entry to raw64-only, so a peer that restarted on a
+        legacy build stops receiving coded/stamped/relayed frames
+        immediately instead of keeping its previous incarnation's caps.
+        Features WE speak that the new hello does not grant are traced
+        (`feature_degraded{feature,peer}`) and counted, once per
+        observed set — a re-hello with the same caps is silent, an
+        upgrade clears the entry, a downgrade re-emits."""
+        recorded = protocol.normalize_hello(caps)
+        self.peer_caps[pid] = recorded
+        lost = protocol.degraded(self.caps, recorded)
+        if lost == self._degraded_seen.get(pid, frozenset()):
+            return
+        self._degraded_seen[pid] = lost
+        for feat in sorted(lost):
+            self._trace("feature_degraded", feature=feat, peer=pid)
+            if self.tele.enabled:
+                self.tele.registry.counter(
+                    protocol.DEGRADED_METRIC, protocol.DEGRADED_HELP,
+                ).inc(feature=feat, peer=str(pid))
 
     def _peer_busy(self, pid: int) -> bool:
         """True while `pid` is deprioritized for gossip: it answered
@@ -1395,7 +1430,10 @@ class PeerAgent:
             "RelayFrames": self._h_relay_frames,
         }
         h = dispatch.get(msg_type)
-        if h is None:
+        if h is None or not protocol.serves(self.caps, msg_type):
+            # second arm: a --protocol-version pin answers feature-gated
+            # messages introduced after its row exactly like the old
+            # build it emulates — unknown method (runtime/protocol.py)
             raise RPCError(f"unknown method {msg_type}")
         return await h(meta, arrays)
 
@@ -1630,6 +1668,16 @@ class PeerAgent:
         order = sorted(p for p in self.peers if p != self.id)
         self._rng.shuffle(order)
         for pid in order:
+            if protocol.SNAPSHOT not in self._grant(pid):
+                # the donor's hello did not grant the snapshot feature
+                # (old build / version pin): it would answer GetSnapshot
+                # with unknown-method — skip it without the wasted RPC.
+                # The announce already recorded every peer's hello, so
+                # an all-legacy fleet exhausts the order and falls back
+                # to the announce path's genesis replay.
+                self._trace("snapshot_refused",
+                            reason="feature_ungranted", peer=pid)
+                continue
             try:
                 rmeta, rarrays = await self._call(
                     pid, "GetSnapshot",
@@ -3692,6 +3740,12 @@ class PeerAgent:
             self._relay_book_offer(st, self.id, offer)
             self._trace("overlay_offer_local")
             return True
+        if protocol.RELAY not in self._grant(relay):
+            # the relay's hello did not grant the relay feature (old
+            # build / version pin): seed per-miner fan-out, no wasted RPC
+            self._trace("overlay_offer_fallback", relay=relay,
+                        error="feature_ungranted")
+            return False
         try:
             await self._call(relay, "OverlayOffer", offer_meta, {
                 "share_rows": offer["shares"],
@@ -3836,7 +3890,14 @@ class PeerAgent:
         for idx, m in enumerate(miners):
             sl = ss.miner_rows(cfg.total_shares, idx, len(miners))
             ok = False
-            if comms_sum is not None and len(members) >= 2:
+            if (comms_sum is not None and len(members) >= 2
+                    and protocol.RELAY not in self._grant(m)):
+                # the miner's hello did not grant the relay feature (old
+                # build / version pin): skip straight to the per-member
+                # forwarding below without burning an RPC on a refusal
+                self._trace("overlay_aggregate_refused", miner=m,
+                            error="feature_ungranted")
+            elif comms_sum is not None and len(members) >= 2:
                 try:
                     await self._call(m, "RegisterAggregate", {
                         "iteration": st.iteration, "source_id": self.id,
@@ -3980,6 +4041,14 @@ class PeerAgent:
         forwarding to `ts`. On ANY failure the orphaned targets get the
         seed path's direct sends — the missing-interior-node
         degradation, shared by the update and block broadcast paths."""
+        if protocol.RELAY not in self._grant(relay):
+            # relay feature ungranted (old build / version pin): the
+            # whole leg degrades to direct sends without a wasted RPC
+            self._trace("overlay_relay_fallback", relay=relay,
+                        error="feature_ungranted")
+            await asyncio.gather(*(
+                self._safe_call(t, inner_type, meta, arrays) for t in ts))
+            return
         try:
             await self._call(relay, "RelayFrames", {
                 "iteration": it, "source_id": self.id,
@@ -4693,10 +4762,13 @@ class PeerAgent:
                 self._render_metrics, self.cfg.my_ip,
                 self.cfg.metrics_port + self.id)
         if self.id != 0:
-            if self.cfg.snapshot_bootstrap:
+            if self.cfg.snapshot_bootstrap \
+                    and protocol.SNAPSHOT in self.caps:
                 # membership plane: hello everywhere WITHOUT chain bodies,
                 # then catch up from one peer's sealed snapshot — the
-                # pre-snapshot history never crosses the wire
+                # pre-snapshot history never crosses the wire. A
+                # --protocol-version pin predating the snapshot feature
+                # joins like the old build: full-chain announce.
                 await self._announce(want_chain=False)
                 await self._snapshot_bootstrap()
             else:

@@ -374,6 +374,12 @@ class RPCServer:
         # seed); `metrics` ticks inbound/outbound byte counters
         self.caps = wcodecs.RAW_CAPS
         self.metrics = None
+        # protocol plane (runtime/protocol.py): whether shed replies
+        # carry the structured retryable `busy` status. True by default
+        # (bare harness servers keep today's behavior); the owning peer
+        # clears it when a --protocol-version pin predates the busy
+        # feature, emulating the old build's plain-error shed reply.
+        self.busy_status = True
         # overload-governance knobs (runtime/admission.py), set by the
         # owning peer when its AdmissionPlan is enabled: `admission` is
         # the AdmissionController consulted per decoded frame (None =
@@ -486,10 +492,10 @@ class RPCServer:
             return  # fire-and-forget: nobody is waiting for a reply
         if stream._w_paused or not stream.alive:
             return  # peer not draining: drop the notification
-        parts = msgs.encode_parts(
-            msg_type + ".reply",
-            {"error": f"admission shed: {reason}", "busy": True,
-             "rid": rid}, {})
+        reply = {"error": f"admission shed: {reason}", "rid": rid}
+        if self.busy_status:
+            reply["busy"] = True
+        parts = msgs.encode_parts(msg_type + ".reply", reply, {})
         try:
             stream.write_parts(parts)
         except (ConnectionError, OSError):

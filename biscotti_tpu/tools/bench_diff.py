@@ -38,10 +38,21 @@ from typing import Dict
 # sources through must fail a bench diff loudly. The ENSEMBLE defense
 # row's guard cell (hug_ensemble, ISSUE 16) is covered by the same two
 # suffixes — bench.py emits its failed/accepted_poisoned_n under
-# attack_matrix.hug_ensemble, no new pattern needed.
+# attack_matrix.hug_ensemble, no new pattern needed. The soak-SLO
+# family (tools/soak.py SOAK_*.json, docs/SOAK.md) adds three
+# lower-is-better keys the suffix rules don't already cover:
+# `rss_drift_bytes_per_h` (leak rate — p99 latency and bytes/round ride
+# the existing `_s` / `bytes_per_round` suffixes), `shed_rate` and
+# `stall_rate` (admission sheds / round stalls per round — an endurance
+# run shedding or stalling MORE at equal load is a robustness
+# regression even when latency still clears its gate). Thresholds are
+# the shared --threshold (+10% default): soak gates carry generous
+# absolute limits, so the diff's job is catching relative creep between
+# two soaks of the same scenario.
 DEFAULT_REGRESS = (r"(?<!points_per)(_s|_seconds|_secs|round_total|"
                    r"bytes_per_round|_bytes|crypto_s|final_error|"
-                   r"failed|accepted_poisoned_n)$")
+                   r"failed|accepted_poisoned_n|rss_drift_bytes_per_h|"
+                   r"shed_rate|stall_rate)$")
 
 
 def load_artifact(path: str) -> Dict:

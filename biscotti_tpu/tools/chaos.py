@@ -258,6 +258,22 @@ def main(argv=None) -> int:
                          "matrix replays with batched miner crypto on "
                          "device; the report records which crypto path "
                          "actually ran (docs/CRYPTO_KERNELS.md)")
+    ap.add_argument("--protocol-version", type=int, default=-1,
+                    help="pin EVERY peer's advertised feature set to "
+                         "this historical protocol row (old-build "
+                         "emulation, runtime/protocol.py; -1 = current "
+                         "— docs/PROTOCOL.md)")
+    ap.add_argument("--rolling-upgrade", type=int, default=-1,
+                    help="start every non-anchor peer pinned to this "
+                         "protocol version row, then restart them "
+                         "wave-by-wave onto the current build mid-run "
+                         "(the mixed-version rolling-upgrade drill, "
+                         "docs/PROTOCOL.md); the settled-prefix oracle "
+                         "must hold across the whole timeline")
+    ap.add_argument("--upgrade-period", type=int, default=3,
+                    help="rounds between rolling-upgrade waves")
+    ap.add_argument("--upgrade-wave", type=int, default=2,
+                    help="peers restarted per rolling-upgrade wave")
     ns = ap.parse_args(argv)
     # --flood-node: a static id, or the `miner` sentinel (per-round
     # elected-miner targeting via the campaign plane's observation hook)
@@ -337,13 +353,54 @@ def main(argv=None) -> int:
                  f"--campaign-recycle-period ({ns.campaign_recycle_period})"
                  " or shrink the period")
 
+    # rolling-upgrade drill (docs/PROTOCOL.md): the pre-upgrade fleet
+    # (every non-anchor peer) speaks the pinned historical row; waves of
+    # --upgrade-wave peers are hard-restarted onto the current build
+    # every --upgrade-period anchor rounds — the same ChurnRunner the
+    # churn plane uses, so upgrade restarts compose with churn/flood/slow
+    # in one seeded replayable run
+    from biscotti_tpu.runtime import protocol as _protocol
+    upgrade_events: list = []
+    upgrade_round: Dict[int, int] = {}
+    upgrade_waves: list = []
+    if ns.rolling_upgrade >= 0 and ns.protocol_version >= 0:
+        ap.error("--rolling-upgrade already pins the pre-upgrade fleet; "
+                 "it cannot combine with --protocol-version")
+    if ns.protocol_version > _protocol.CURRENT_VERSION:
+        ap.error(f"--protocol-version {ns.protocol_version} outside "
+                 f"0..{_protocol.CURRENT_VERSION}")
+    if ns.rolling_upgrade >= 0:
+        if not 0 <= ns.rolling_upgrade < _protocol.CURRENT_VERSION:
+            # upgrading FROM the current version is a no-op drill — the
+            # same mislabeling the empty-campaign guard refuses
+            ap.error(f"--rolling-upgrade {ns.rolling_upgrade} must be a "
+                     f"historical row in "
+                     f"0..{_protocol.CURRENT_VERSION - 1}")
+        wave = max(1, ns.upgrade_wave)
+        targets = [i for i in range(ns.nodes) if i != 0]
+        for w in range(0, len(targets), wave):
+            at = ns.upgrade_period * (w // wave + 1)
+            upgrade_waves.append([at, targets[w:w + wave]])
+            for node in targets[w:w + wave]:
+                upgrade_round[node] = at
+        last = upgrade_waves[-1][0]
+        if last >= ns.rounds:
+            ap.error(f"rolling upgrade's last wave lands at round {last} "
+                     f"but the run stops at --rounds {ns.rounds}: raise "
+                     f"--rounds or widen --upgrade-wave")
+
     import jax
 
     jax.config.update("jax_enable_x64", True)
 
+    from biscotti_tpu.runtime import faults as _faults
     from biscotti_tpu.runtime.admission import AdmissionPlan
     from biscotti_tpu.runtime.faults import FaultPlan
     from biscotti_tpu.runtime.peer import PeerAgent
+
+    for node, at in sorted(upgrade_round.items()):
+        upgrade_events.append(_faults.ChurnEvent(round=at, node=node,
+                                                 kind=_faults.RESTART))
 
     churn_seed = ns.fault_seed if ns.churn_seed < 0 else ns.churn_seed
     # one plan: the frame-fault schedule keys off --fault-seed, the
@@ -408,6 +465,16 @@ def main(argv=None) -> int:
 
     def cfg(i):
         flooding = ns.flood > 0 and not flood_at_miner and i == flood_node
+        # protocol pin for THIS incarnation: under --rolling-upgrade a
+        # non-anchor peer speaks the old row until its upgrade wave has
+        # fired (restarts are applied at anchor height >= the wave round,
+        # so any relaunch from that point on comes up on the new build —
+        # exactly how a supervisor rolling a new binary behaves)
+        pin = ns.protocol_version
+        if ns.rolling_upgrade >= 0 and i != 0:
+            height = made[0].iteration if 0 in made else 0
+            pin = (ns.rolling_upgrade
+                   if height < upgrade_round.get(i, 0) else -1)
         return BiscottiConfig(
             node_id=i, num_nodes=ns.nodes, dataset=ns.dataset,
             base_port=ns.base_port, num_verifiers=ns.verifiers,
@@ -431,6 +498,7 @@ def main(argv=None) -> int:
             # one-seed replayable across all composed planes
             overlay=bool(ns.overlay), overlay_group=overlay_group,
             device_crypto=bool(ns.device_crypto),
+            protocol_version=pin,
             wire_codec=ns.codec)
 
     # the sybil campaign's identity recycling rides the same runner the
@@ -445,12 +513,13 @@ def main(argv=None) -> int:
         made[i] = a  # latest incarnation; node 0 is never churned
         return a
 
-    if ns.churn > 0 or recycle_events:
+    if ns.churn > 0 or recycle_events or upgrade_events:
         from biscotti_tpu.runtime.membership import (ChurnRunner,
                                                      surviving_prefix_oracle)
 
         schedule = sorted(
-            plan.churn_schedule(ns.nodes, ns.rounds) + recycle_events,
+            plan.churn_schedule(ns.nodes, ns.rounds) + recycle_events
+            + upgrade_events,
             key=lambda e: (e.round, e.node, e.kind))
 
         async def go():
@@ -520,6 +589,27 @@ def main(argv=None) -> int:
                   "period": ns.churn_period, "down": ns.churn_down,
                   "events_applied": applied}
                  if ns.churn else None,
+        # rolling-upgrade timeline (docs/PROTOCOL.md): the planned waves,
+        # the restarts the runner actually applied, and each surviving
+        # peer's FINAL advertised protocol version off its telemetry —
+        # a completed drill reads all-current with the settled-prefix
+        # oracle intact across the mixed-version span
+        "rolling_upgrade": ({
+            "from_version": ns.rolling_upgrade,
+            "to_version": _protocol.CURRENT_VERSION,
+            "period": ns.upgrade_period,
+            "wave": max(1, ns.upgrade_wave),
+            "waves": upgrade_waves,
+            "applied": [[r, n] for (r, n, k) in (applied or [])
+                        if k == _faults.RESTART
+                        and upgrade_round.get(n) == r],
+            "final_versions": {
+                str(s["node"]): s.get("protocol", {}).get("version")
+                for s in (r["telemetry"] for r in results
+                          if "telemetry" in r)},
+        } if ns.rolling_upgrade >= 0 else None),
+        "protocol_pin": (ns.protocol_version
+                         if ns.protocol_version >= 0 else None),
         "slow": {"fraction": ns.slow, "node": ns.slow_node,
                  "factor": ns.slow_factor, "preset": ns.slow_preset,
                  "profiles": {

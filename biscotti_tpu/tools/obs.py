@@ -218,14 +218,22 @@ def merge_hives(snaps: List[Dict]) -> Dict[str, Dict]:
             "rss_bytes": int(h.get("rss_bytes", 0)),
             "rss_peak_bytes": int(h.get("rss_peak_bytes", 0)),
             "loop_lag_s": float(h.get("loop_lag_s", 0.0)),
+            "rss_drift_bytes": int(h.get("rss_drift_bytes", 0)),
+            "loop_lag_drift_s": float(h.get("loop_lag_drift_s", 0.0)),
         })
         row["scraped"] += 1
-        # a later snapshot of the same hive may carry fresher samples
+        # a later snapshot of the same hive may carry fresher samples;
+        # drift keeps the worst (most positive) window — a leak that
+        # briefly plateaus should not launder the gauge
         row["rss_bytes"] = max(row["rss_bytes"], int(h.get("rss_bytes", 0)))
         row["rss_peak_bytes"] = max(row["rss_peak_bytes"],
                                     int(h.get("rss_peak_bytes", 0)))
         row["loop_lag_s"] = max(row["loop_lag_s"],
                                 float(h.get("loop_lag_s", 0.0)))
+        row["rss_drift_bytes"] = max(row["rss_drift_bytes"],
+                                     int(h.get("rss_drift_bytes", 0)))
+        row["loop_lag_drift_s"] = max(
+            row["loop_lag_drift_s"], float(h.get("loop_lag_drift_s", 0.0)))
     for row in out.values():
         row["rss_per_peer_bytes"] = int(
             row["rss_peak_bytes"] / max(1, row["peers_cohosted"]))
@@ -519,12 +527,14 @@ def format_table(merged: Dict) -> str:
     hives = merged.get("hives") or {}
     if hives:
         lines += ["", f"{'hive':<16} {'peers':>6} {'scraped':>8} "
-                      f"{'rss':>9} {'rss/peer':>9} {'looplag':>8}"]
+                      f"{'rss':>9} {'rss/peer':>9} {'rssdrift':>9} "
+                      f"{'looplag':>8}"]
         for hid, h in sorted(hives.items()):
             lines.append(
                 f"{hid:<16} {h['peers_cohosted']:>6} {h['scraped']:>8} "
                 f"{_fmt_bytes(h['rss_peak_bytes']):>9} "
                 f"{_fmt_bytes(h['rss_per_peer_bytes']):>9} "
+                f"{_fmt_bytes(h.get('rss_drift_bytes', 0)):>9} "
                 f"{h['loop_lag_s']:>8.4f}")
     if merged["faults"]:
         lines += ["", "injected faults (cluster): " + ", ".join(

@@ -99,8 +99,39 @@ def test_min_pct_filter_keeps_regressions():
     ("round_total_s", True), ("miner_crypto_s", True),
     ("wire_bytes_per_round", True), ("final_error", True),
     ("accepted_per_round", False), ("nodes", False),
+    # the soak-SLO family (tools/soak.py SOAK_*.json, docs/SOAK.md)
+    ("slos.p99_round_latency_s", True),
+    ("slos.cross_host_bytes_per_round", True),
+    ("slos.rss_drift_bytes_per_h", True),
+    ("slos.shed_rate", True), ("slos.stall_rate", True),
+    ("cycles_run", False), ("latency_samples", False),
 ])
 def test_default_regress_pattern_targets_lower_is_better(key, expect):
     import re
 
     assert bool(re.search(bd.DEFAULT_REGRESS, key)) is expect
+
+
+SOAK = {
+    "schema": "soak-v1", "cycles_run": 4, "settled_rounds": 40,
+    "slos": {"p99_round_latency_s": 4.0,
+             "cross_host_bytes_per_round": 100000.0,
+             "rss_drift_bytes_per_h": 1.0e7,
+             "shed_rate": 20.0, "stall_rate": 0.5},
+}
+
+
+@pytest.mark.parametrize("gate", sorted(SOAK["slos"]))
+def test_soak_artifact_regression_fails_per_gate(tmp_path, gate):
+    """Every gated soak SLO is individually regressable: an artifact
+    whose ONE gate value worsened past the threshold exits 1 — so a
+    soak landing in CI fails on exactly the SLO that crept."""
+    base = _write(tmp_path, "base.json", SOAK)
+    worse_obj = {**SOAK, "slos": dict(SOAK["slos"],
+                                      **{gate: SOAK["slos"][gate] * 1.5})}
+    worse = _write(tmp_path, f"worse_{gate}.json", worse_obj)
+    assert bd.main([base, base]) == 0
+    assert bd.main([base, worse, "--threshold", "0.10"]) == 1
+    # and the regression names the exact gate
+    d = bd.diff(bd.flatten(SOAK), bd.flatten(worse_obj), threshold=0.10)
+    assert [r["key"] for r in d["regressions"]] == [f"slos.{gate}"]

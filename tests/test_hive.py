@@ -392,11 +392,14 @@ def test_obs_merges_per_hive_table():
     the co-hosted count, RSS/peer, and the event-loop lag gauge."""
     from biscotti_tpu.tools import obs
 
-    def snap(hid, peers, rss, lag):
+    def snap(hid, peers, rss, lag, drift=0):
         return {"hive": {"id": hid, "peers": peers, "rss_bytes": rss,
-                         "rss_peak_bytes": rss, "loop_lag_s": lag}}
+                         "rss_peak_bytes": rss, "loop_lag_s": lag,
+                         "rss_drift_bytes": drift,
+                         "loop_lag_drift_s": lag / 10}}
 
-    snaps = [snap("h0", 2, 100 << 20, 0.01), snap("h0", 2, 120 << 20, 0.5),
+    snaps = [snap("h0", 2, 100 << 20, 0.01, drift=1 << 20),
+             snap("h0", 2, 120 << 20, 0.5),
              snap("h1", 3, 90 << 20, 0.02), {"other": True}]
     # avoided-traffic accounting: loopback-direction wire bytes must
     # surface in the merged wire table (a fully co-hosted cluster would
@@ -415,7 +418,30 @@ def test_obs_merges_per_hive_table():
     assert hives["h0"]["loop_lag_s"] == 0.5            # starvation visible
     assert hives["h0"]["rss_per_peer_bytes"] == (120 << 20) // 2
     assert hives["h1"]["peers_cohosted"] == 3
+    # drift keeps the worst window even when a later scrape reads lower
+    assert hives["h0"]["rss_drift_bytes"] == 1 << 20
+    assert hives["h0"]["loop_lag_drift_s"] == 0.05
     table = obs.format_table(merged)
     assert "rss/peer" in table and "looplag" in table
+    assert "rssdrift" in table and "1.0MB" in table
     assert "h0" in table and "0.5000" in table
     assert "loopback 4.0KB avoided" in table
+
+
+def test_drift_is_quarter_median_delta():
+    """runtime/hive.drift: windowed RSS/loop-lag drift must survive
+    allocator sawtooth (quarter medians, not last-minus-first) and stay
+    zero until the window holds one sample per quarter."""
+    from biscotti_tpu.runtime.hive import drift
+
+    assert drift([]) == 0.0
+    assert drift([5.0, 6.0, 7.0]) == 0.0          # <4 samples: no signal
+    # monotone leak: newest-quarter median minus oldest-quarter median
+    assert drift([0.0, 1.0, 2.0, 3.0]) == 3.0
+    assert drift(list(range(8))) == pytest.approx((6 + 7) / 2 - (0 + 1) / 2)
+    # sawtooth with no trend: one outlier spike must NOT read as drift
+    saw = [100.0, 104.0] * 12                     # quarter = 6, even
+    assert drift(saw) == 0.0
+    assert abs(drift(saw + [400.0])) <= 4.0       # spike stays invisible
+    # flat-then-step leak is visible
+    assert drift([100.0] * 10 + [164.0] * 10) == 64.0

@@ -1,0 +1,219 @@
+"""Versioned protocol plane unit tests (runtime/protocol.py,
+docs/PROTOCOL.md): the version-row registry, pinned advertisement,
+grant/degraded derivation, the ONE legacy-hello reset rule applied
+uniformly across every capability family, and the degradation
+trace/counter plumbing in PeerAgent."""
+
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.runtime import codecs as wcodecs
+from biscotti_tpu.runtime import protocol
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.telemetry import tracectx
+
+FAST = Timeouts(update_s=20.0, block_s=60.0, krum_s=20.0, share_s=20.0,
+                rpc_s=10.0)
+
+
+def _cfg(i=0, n=3, port=12700, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=2, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_feature_ids_match_their_planes():
+    """The registry's ids must BE the tokens the planes negotiate with —
+    a drifted constant would silently stop granting a feature."""
+    assert protocol.TRACE == tracectx.TRACE_CAP
+    assert protocol.RAW == wcodecs.RAW
+    assert wcodecs.CHUNK_CAP in protocol.FEATURES
+    assert protocol.LEGACY_CAPS == wcodecs.RAW_CAPS
+
+
+def test_version_rows_are_cumulative_and_bounded():
+    assert protocol.version_row(0) == frozenset({protocol.RAW})
+    prev = frozenset()
+    for v in range(protocol.CURRENT_VERSION + 1):
+        row = protocol.version_row(v)
+        assert prev <= row, f"row {v} dropped features {prev - row}"
+        prev = row
+    assert prev == frozenset(protocol.FEATURES)
+    for bad in (-1, protocol.CURRENT_VERSION + 1, 99):
+        with pytest.raises(ValueError):
+            protocol.version_row(bad)
+
+
+def test_version_history_is_pinned():
+    """The PR-by-PR protocol history is a contract: codecs entered at
+    v2, admission busy-status at v3, snapshot bootstrap at v4, overlay
+    relay at v5, trace at v6, structured advertisement at v7. Moving a
+    row rewrites history that deployed builds already advertise."""
+    assert protocol.CURRENT_VERSION == 7
+    f = protocol.FEATURES
+    assert f[protocol.RAW].version == 0
+    assert all(f[c].version == 2
+               for c in ("topk", "bf16", "f32", "zlib", wcodecs.CHUNK_CAP))
+    assert f[protocol.BUSY].version == 3
+    assert f[protocol.SNAPSHOT].version == 4
+    assert f[protocol.RELAY].version == 5
+    assert f[protocol.TRACE].version == 6
+    assert f[protocol.PROTO].version == 7
+    m = protocol.MESSAGES
+    assert m["RegisterPeer"].version == 0 and not m["RegisterPeer"].feature
+    assert m["GetSnapshot"].feature == protocol.SNAPSHOT
+    assert m["RelayFrames"].feature == protocol.RELAY
+    # every gating feature is itself registered, at or before its message
+    for msg in m.values():
+        if msg.feature:
+            assert msg.feature in f
+            assert f[msg.feature].version <= msg.version
+
+
+# -------------------------------------------------- advertise / serve
+
+
+def test_advertised_follows_config_and_pin():
+    full = protocol.advertised(_cfg(wire_codec="f32+zlib", trace=True,
+                                    overlay=True, overlay_group=2))
+    assert {"f32", "zlib", wcodecs.CHUNK_CAP, protocol.TRACE,
+            protocol.RELAY, protocol.BUSY, protocol.SNAPSHOT,
+            protocol.PROTO} <= full
+    # config gates what IS advertised inside the row
+    plain = protocol.advertised(_cfg())
+    assert protocol.TRACE not in plain and protocol.RELAY not in plain
+    assert protocol.BUSY in plain and protocol.PROTO in plain
+    # a pin caps the row: version 0 is the seed build — raw64 only,
+    # regardless of what the config asks for
+    pinned = protocol.advertised(_cfg(wire_codec="f32+zlib", trace=True,
+                                      overlay=True, overlay_group=2,
+                                      protocol_version=0))
+    assert pinned == frozenset({protocol.RAW})
+    # version 2 grants codecs but predates busy/snapshot/relay/trace
+    v2 = protocol.advertised(_cfg(wire_codec="f32+zlib", trace=True,
+                                  protocol_version=2))
+    assert {"f32", "zlib"} <= v2
+    assert not v2 & {protocol.BUSY, protocol.SNAPSHOT, protocol.RELAY,
+                     protocol.TRACE, protocol.PROTO}
+
+
+def test_serves_answers_like_the_pinned_build():
+    v0 = protocol.advertised(_cfg(protocol_version=0))
+    assert protocol.serves(v0, "RegisterBlock")       # must-serve seed msg
+    assert protocol.serves(v0, "Metrics")             # ungated
+    assert not protocol.serves(v0, "GetSnapshot")     # post-row: unknown
+    assert not protocol.serves(v0, "RelayFrames")
+    full = protocol.advertised(_cfg(overlay=True, overlay_group=2,
+                                    snapshot_bootstrap=True))
+    assert protocol.serves(full, "GetSnapshot")
+    assert protocol.serves(full, "RelayFrames")
+    # unregistered types defer to the dispatch table (the lint keeps
+    # that set empty)
+    assert protocol.serves(v0, "NotARealMessage")
+
+
+def test_config_refuses_out_of_range_pins():
+    assert BiscottiConfig(protocol_version=-1).protocol_version == -1
+    assert BiscottiConfig(protocol_version=0).protocol_version == 0
+    for bad in (-2, protocol.CURRENT_VERSION + 1):
+        with pytest.raises(ValueError):
+            BiscottiConfig(protocol_version=bad)
+
+
+# --------------------------------------------------- grant / degraded
+
+
+def test_grant_is_intersection_with_raw_floor():
+    own = frozenset({protocol.RAW, "f32", protocol.TRACE})
+    theirs = frozenset({protocol.RAW, "f32", protocol.RELAY})
+    assert protocol.grant(own, theirs) == {protocol.RAW, "f32"}
+    assert protocol.grant(own, None) == {protocol.RAW}
+    assert protocol.degraded(own, theirs) == {protocol.TRACE}
+    assert protocol.degraded(own, None) == {"f32", protocol.TRACE}
+    assert protocol.degraded(own, own) == frozenset()
+
+
+# ---------------------- the ONE legacy-hello reset rule, every family
+
+
+LEGACY_HELLOS = [None, 42, "raw64", {"caps": ["f32"]}, 3.14]
+
+# (family, probe) — probe(agent, pid) is True iff the feature is
+# currently granted toward pid. One idiom covers every capability
+# family the protocol has grown: codec stages, chunking, trace
+# stamping, overlay relay, snapshot bootstrap, and the registry's own
+# busy/proto advertisement.
+FAMILIES = [
+    ("codecs", lambda a, p: a._wire_to(p)[0] != wcodecs.RAW),
+    ("chunk", lambda a, p: a._wire_to(p)[1] > 0),
+    ("trace", lambda a, p: a._peer_traces(p)),
+    ("relay", lambda a, p: protocol.RELAY in a._grant(p)),
+    ("snapshot", lambda a, p: protocol.SNAPSHOT in a._grant(p)),
+    ("busy", lambda a, p: protocol.BUSY in a._grant(p)),
+    ("proto", lambda a, p: protocol.PROTO in a._grant(p)),
+]
+
+
+@pytest.mark.parametrize("family,probe", FAMILIES,
+                         ids=[f for f, _ in FAMILIES])
+def test_legacy_hello_resets_every_family(family, probe):
+    """One parameterized walk per capability family: ungranted before
+    any hello, granted after a full-caps hello, reset by EVERY malformed
+    legacy-hello shape — and the loss lands in the degradation readout.
+    The reset rule lives in exactly one place (protocol.normalize_hello);
+    this suite is what keeps new families from growing private copies."""
+    a = PeerAgent(_cfg(wire_codec="f32+zlib", trace=True, overlay=True,
+                       overlay_group=2, wire_chunk_bytes=1 << 20))
+    assert not probe(a, 1), f"{family} granted before any hello"
+    a._record_caps(1, sorted(a.caps))
+    assert probe(a, 1), f"{family} not granted by a full hello"
+    for hello in LEGACY_HELLOS:
+        a._record_caps(1, sorted(a.caps))
+        assert probe(a, 1)
+        a._record_caps(1, hello)
+        assert not probe(a, 1), \
+            f"{family} survived legacy hello {hello!r}"
+        feat = {"codecs": "f32", "chunk": wcodecs.CHUNK_CAP,
+                "trace": protocol.TRACE, "relay": protocol.RELAY,
+                "snapshot": protocol.SNAPSHOT, "busy": protocol.BUSY,
+                "proto": protocol.PROTO}[family]
+        assert feat in a._degraded_seen[1]
+
+
+def test_degradation_trace_dedupes_per_observed_set():
+    a = PeerAgent(_cfg(port=12705, wire_codec="f32+zlib", trace=True))
+    a._record_caps(2, None)
+    first = a.counters.get("feature_degraded", 0)
+    assert first >= 3  # f32, zlib, chunk, trace, ... all lost
+    a._record_caps(2, None)  # same observed set: no re-emission
+    assert a.counters.get("feature_degraded", 0) == first
+    a._record_caps(2, sorted(a.caps))  # recovered: degradations clear
+    assert a._degraded_seen[2] == frozenset()
+    a._record_caps(2, None)  # lost again: a NEW observation, re-traced
+    assert a.counters.get("feature_degraded", 0) == 2 * first
+    # and the metric family carries per-feature/per-peer labels
+    fam = a.tele.registry.snapshot().get(protocol.DEGRADED_METRIC, {})
+    labels = {tuple(sorted(s["labels"])) for s in fam.get("series", [])}
+    assert labels == {("feature", "peer")}
+
+
+def test_telemetry_snapshot_carries_protocol_readout():
+    a = PeerAgent(_cfg(port=12710, wire_codec="f32+zlib"))
+    a._record_caps(1, None)
+    snap = a.telemetry_snapshot()["protocol"]
+    assert snap["version"] == protocol.CURRENT_VERSION
+    assert snap["current"] == protocol.CURRENT_VERSION
+    assert set(snap["advertised"]) == set(a.caps)
+    assert "f32" in snap["degraded"][1]
+    pinned = PeerAgent(_cfg(node_id=1, port=12710, protocol_version=0))
+    psnap = pinned.telemetry_snapshot()["protocol"]
+    assert psnap["version"] == 0 and psnap["advertised"] == ["raw64"]

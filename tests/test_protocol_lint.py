@@ -1,0 +1,82 @@
+"""Protocol-registry lint (tier-1): every RPC message type the runtime
+dispatches and every negotiated feature appears in the
+runtime/protocol.py version table AND in docs/PROTOCOL.md — and vice
+versa, no registered-but-dead rows. The registry is the single source
+of truth the mixed-version matrix and rolling-upgrade drills are built
+against; this lint is what keeps an unregistered frame evolution from
+landing silently (the metric-name lint's sibling, same AST approach)."""
+
+import ast
+import pathlib
+
+from biscotti_tpu.runtime import protocol
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PEER = REPO / "biscotti_tpu" / "runtime" / "peer.py"
+DOC = REPO / "docs" / "PROTOCOL.md"
+
+
+def dispatch_message_types():
+    """The literal keys of PeerAgent's `dispatch = {...}` table, scanned
+    from the AST so a handler added without registering its message
+    fails here rather than at a mixed-version peer's first frame."""
+    tree = ast.parse(PEER.read_text())
+    tables = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            if any(isinstance(t, ast.Name) and t.id == "dispatch"
+                   for t in node.targets):
+                tables.append(node.value)
+    assert tables, "peer.py no longer assigns a `dispatch = {...}` table"
+    keys = set()
+    for table in tables:
+        for k in table.keys:
+            assert isinstance(k, ast.Constant) and isinstance(k.value, str), \
+                "dispatch table keys must be string literals (the lint " \
+                "cannot see a computed key)"
+            keys.add(k.value)
+    return keys
+
+
+def test_every_dispatched_message_is_registered():
+    dispatched = dispatch_message_types()
+    registered = set(protocol.MESSAGES)
+    missing = sorted(dispatched - registered)
+    assert not missing, (
+        f"RPC message types dispatched in peer.py but missing from "
+        f"protocol.MESSAGES: {missing} — add a row with the version it "
+        f"entered the protocol and its gating feature")
+    dead = sorted(registered - dispatched)
+    assert not dead, (
+        f"message types registered in protocol.MESSAGES but dispatched "
+        f"nowhere: {dead} — delete the stale rows")
+
+
+def test_every_message_and_feature_is_documented():
+    doc = DOC.read_text()
+    missing = sorted(
+        [m for m in protocol.MESSAGES if f"`{m}`" not in doc]
+        + [f for f in protocol.FEATURES if f"`{f}`" not in doc])
+    assert not missing, (
+        f"protocol registry rows missing from docs/PROTOCOL.md: "
+        f"{missing} — the doc table is the upgrade contract")
+
+
+def test_registry_rows_are_well_formed():
+    for f in protocol.FEATURES.values():
+        assert 0 <= f.version <= protocol.CURRENT_VERSION, f
+        assert f.summary, f"feature {f.id} has no summary"
+    for m in protocol.MESSAGES.values():
+        assert 0 <= m.version <= protocol.CURRENT_VERSION, m
+        assert m.summary, f"message {m.name} has no summary"
+        if m.feature:
+            assert m.feature in protocol.FEATURES, (
+                f"{m.name} gated on unregistered feature {m.feature!r}")
+
+
+def test_degraded_metric_documented_in_observability():
+    # the feature_degraded family rides the metric lint too; this is the
+    # cheap direct check so a rename fails HERE with a protocol message
+    obs = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    assert protocol.DEGRADED_METRIC in obs, (
+        f"{protocol.DEGRADED_METRIC} missing from docs/OBSERVABILITY.md")

@@ -183,7 +183,11 @@ def test_slow_trust_ramp_graduation_and_absence_reset():
 def test_slow_trust_duty_cycle_gates_without_arming_hold():
     """A ramping identity is throttled to its weight's duty cycle; the
     pure slow_trust vote must NOT arm the hysteresis hold, or a fresh
-    identity could never accrue the accepts it needs to graduate."""
+    identity could never accrue the accepts it needs to graduate.
+
+    Credit is CHAIN-derived: each round's decision is followed by the
+    block it produced — an accepted record consumes the pass, a
+    throttled round banks its weight as an eligible absence."""
     led = TrustLedger(TrustPlan(ramp_rounds=4, ramp_floor=0.4), 2)
     led.seed_fresh([0])
     walk = []
@@ -191,10 +195,44 @@ def test_slow_trust_duty_cycle_gates_without_arming_hold():
         accepts, votes, _ = _neutral_decide(led, it, [0, 1])
         walk.append((accepts[0], tuple(votes[0])))
         assert accepts[1] and not votes[1]       # veteran untouched
-    # credit 0.4 / 0.8 / 1.2->accept / 0.6 / 1.0->accept
+        records = {1: True}
+        if accepts[0]:
+            records[0] = True            # the pass lands on the chain
+        led.sync_block(it, records, committee=set())
+    # credit 0.4 / 0.8 / 1.2->accept(->0.75) / 1.3->accept
     assert walk == [(False, ("slow_trust",)), (False, ("slow_trust",)),
                     (True, ()), (False, ("slow_trust",)), (True, ())]
     assert led._peers[0].hold == 0
+
+
+def test_slow_trust_verdict_unanimous_across_churned_committees():
+    """Chain-derived credit (ROADMAP item 2b residual): verifiers that
+    folded the same committed blocks issue the IDENTICAL slow_trust
+    verdict regardless of which rounds each of them happened to decide.
+    Before this change the credit accumulator mutated inside decide(),
+    so a freshly seated verifier on a churned committee disagreed with
+    a veteran one about a ramping identity — a per-round verdict split
+    the protocol's majority-approval then had to paper over."""
+    plan = TrustPlan(ramp_rounds=4, ramp_floor=0.4)
+    veteran = TrustLedger(plan, 3)   # decides EVERY round
+    joiner = TrustLedger(plan, 3)    # seated late: only folds the chain
+    for led in (veteran, joiner):
+        led.seed_fresh([0])
+    for it in range(6):
+        accepts, _, _ = _neutral_decide(veteran, it, [0, 1])
+        records = {1: True}
+        if accepts[0]:
+            records[0] = True
+        for led in (veteran, joiner):
+            led.sync_block(it, records, committee=set())
+    assert veteran._peers[0].credit == joiner._peers[0].credit
+    va = _neutral_decide(veteran, 6, [0, 1])
+    ja = _neutral_decide(joiner, 6, [0, 1])
+    assert va == ja
+    # and deciding is side-effect-free on the credit state: replaying
+    # the same decision yields the same verdict (idempotent verdicts
+    # are what make committee rotation safe)
+    assert _neutral_decide(joiner, 6, [0, 1]) == ja
 
 
 def test_proven_gate_exempts_veterans_from_one_shot_vetoes():

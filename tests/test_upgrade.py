@@ -1,0 +1,142 @@
+"""Mixed-version interop matrix + rolling-upgrade drills
+(runtime/protocol.py, tools/chaos.py --rolling-upgrade,
+docs/PROTOCOL.md): pinned-old peers among current ones finish with
+equal chains while both wire dialects flow and the degradations are
+traced; a wave-by-wave mid-training upgrade holds the settled-prefix
+oracle end to end."""
+
+import asyncio
+import json
+
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.runtime import protocol
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.tools import chaos, obs
+
+FAST = Timeouts(update_s=20.0, block_s=60.0, krum_s=20.0, share_s=20.0,
+                rpc_s=10.0)
+
+pytestmark = pytest.mark.upgrade
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=2, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+def _run_cluster(cfgs):
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return agents, results
+
+    return asyncio.run(go())
+
+
+def test_mixed_version_matrix_interops_with_observable_degradation():
+    """The live matrix (docs/PROTOCOL.md): two v0-pinned peers among
+    three current ones running coded+traced+overlay config. Chains must
+    come out equal, BOTH dialects must appear in the wire byte counters
+    (coded among new peers, raw64 toward/from the pinned ones), and the
+    codec/trace/overlay degradations must be traced — a silent downgrade
+    is exactly what the plane exists to forbid."""
+    n, port = 5, 12750
+    full = dict(wire_codec="f32+zlib", trace=True, overlay=True,
+                overlay_group=2)
+    cfgs = [_cfg(i, n, port, **full,
+                 protocol_version=0 if i >= 3 else -1)
+            for i in range(n)]
+    agents, results = _run_cluster(cfgs)
+
+    equal, common, real = chaos.chain_oracle(results)
+    assert equal, "mixed-version chains diverged"
+    assert real >= 1, "no real block settled across the version gap"
+
+    merged = obs.merge_snapshots([r["telemetry"] for r in results])
+    codecs_seen = set(merged["wire"]["out_by_codec"])
+    assert "raw64" in codecs_seen, codecs_seen
+    assert "f32+zlib" in codecs_seen, (
+        f"coded dialect never flowed between current peers: {codecs_seen}")
+    assert merged["counters"].get("feature_degraded", 0) > 0
+
+    # the degradation readout names the features lost toward the pinned
+    # peers: codec stages, trace stamping, and the overlay relay rows
+    degraded = set()
+    for r in results[:3]:
+        for feats in r["telemetry"]["protocol"]["degraded"].values():
+            degraded.update(feats)
+    assert {"f32", "zlib", protocol.TRACE, protocol.RELAY} <= degraded, \
+        degraded
+    # pinned peers advertise their row, current peers the full set
+    for r in results:
+        snap = r["telemetry"]["protocol"]
+        if r["node"] >= 3:
+            assert snap["version"] == 0
+            assert snap["advertised"] == ["raw64"]
+        else:
+            assert snap["version"] == protocol.CURRENT_VERSION
+            assert protocol.TRACE in snap["advertised"]
+
+
+def test_rolling_upgrade_zero_settled_divergence(capsys):
+    """The rolling-upgrade drill through the chaos CLI: fleet starts
+    pinned to v0, waves of 2 restart onto the current build at anchor
+    rounds 2 and 4. Exit 0 IS the oracle (settled prefix equal + >= 1
+    real block across the whole mixed-version span); the report must
+    show every planned wave applied and every peer finishing current."""
+    rc = chaos.main(["--nodes", "4", "--rounds", "6",
+                     "--base-port", "12850", "--rolling-upgrade", "0",
+                     "--upgrade-period", "2", "--upgrade-wave", "2",
+                     "--codec", "f32+zlib"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    ru = report["rolling_upgrade"]
+    assert ru["from_version"] == 0
+    assert ru["to_version"] == protocol.CURRENT_VERSION
+    assert ru["waves"] == [[2, [1, 2]], [4, [3]]]
+    assert sorted(ru["applied"]) == [[2, 1], [2, 2], [4, 3]]
+    assert set(ru["final_versions"].values()) == \
+        {protocol.CURRENT_VERSION}
+    # the mixed span actually degraded features before the waves landed
+    assert report["cluster"]["counters"].get("feature_degraded", 0) > 0
+    assert report["settled_prefix_equal"] and report["real_blocks"] >= 1
+
+
+@pytest.mark.slow
+def test_rolling_upgrade_acceptance_n8_secure_agg(capsys):
+    """The ISSUE-18 acceptance drill: N=8 under secure aggregation,
+    wave-by-wave upgrade from v0 mid-training, zero settled-prefix
+    divergence and an upgrade timeline in the report."""
+    rc = chaos.main(["--nodes", "8", "--rounds", "8",
+                     "--base-port", "12950", "--rolling-upgrade", "0",
+                     "--upgrade-period", "2", "--upgrade-wave", "3",
+                     "--secure-agg", "1", "--codec", "f32+zlib"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0, report
+    ru = report["rolling_upgrade"]
+    assert [w[0] for w in ru["waves"]] == [2, 4, 6]
+    assert len(ru["applied"]) == 7  # every non-anchor peer upgraded
+    assert set(ru["final_versions"].values()) == \
+        {protocol.CURRENT_VERSION}
+    assert report["settled_prefix_equal"] and report["real_blocks"] >= 1
+
+
+@pytest.mark.parametrize("argv", [
+    ["--rolling-upgrade", "7"],            # from-current is a no-op drill
+    ["--rolling-upgrade", "0", "--protocol-version", "1"],  # conflicting
+    ["--protocol-version", "99"],          # beyond the table
+    ["--rolling-upgrade", "0", "--rounds", "2"],  # waves outlive the run
+])
+def test_chaos_refuses_mislabeled_upgrade_runs(argv):
+    with pytest.raises(SystemExit) as exc:
+        chaos.main(["--nodes", "4"] + argv)
+    assert exc.value.code == 2
