@@ -750,6 +750,88 @@ def bench_attack_matrix(budget_s: float = 600.0):
     return out
 
 
+def bench_migration(n=100, iterations=2, budget_s=600.0):
+    """Migration-cost entry (ISSUE 19): a LIVE two-hive cluster at N=100
+    under the placement controller with a rigged hot-host signal so
+    every decision point actually moves peers — reporting per-move
+    downtime and ticket size (`migration_downtime_s` /
+    `migration_bytes`, the two lower-is-better keys tools/bench_diff
+    gates). The rig goes through the controller's signals_fn seam: on
+    one box the real hive gauges are process-wide, so both hives read
+    equally hot and nothing would move — the injection makes the COST
+    measurable without faking the decision function itself
+    (docs/PLACEMENT.md).
+
+    Set BISCOTTI_BENCH_MIGRATION=0 to skip."""
+    if os.environ.get("BISCOTTI_BENCH_MIGRATION", "1") == "0":
+        return {"skipped": "BISCOTTI_BENCH_MIGRATION=0"}
+    import asyncio
+
+    from biscotti_tpu.config import BiscottiConfig
+    from biscotti_tpu.runtime import placement
+    from biscotti_tpu.runtime.hive import LoopbackHub
+    from biscotti_tpu.runtime.membership import surviving_prefix_oracle
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    _progress(f"migration: N={n} two-hive cluster, rigged hot host")
+    plan = placement.PlacementPlan(enabled=True, seed=0, interval=1,
+                                   max_moves=2, lag_hot_s=0.05)
+    layout = placement.hive_layout(n, 2)
+    hive_ids = [f"host{i}" for i in range(len(layout))]
+    assignment = {}
+    for hid, (start, count) in zip(hive_ids, layout):
+        for node in range(start, start + count):
+            assignment[node] = hid
+    cfg = BiscottiConfig(
+        num_nodes=n, dataset="creditcard", base_port=15700,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=iterations, convergence_error=0.0,
+        sample_percent=1.0, batch_size=8, seed=3,
+        placement_plan=plan)
+    cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
+        n, cfg.num_verifiers, cfg.num_miners))
+    hubs = {hid: LoopbackHub() for hid in hive_ids}
+
+    def make_agent(node, hive_id, ticket):
+        return PeerAgent(cfg.replace(node_id=node), hive=hubs[hive_id],
+                         ticket=ticket)
+
+    def rigged_signals(assignment, agents):
+        by = {}
+        for node, hid in sorted(assignment.items()):
+            by.setdefault(hid, []).append(node)
+        return [placement.HostSignals(
+            hive_id=hid, peers=tuple(nodes),
+            loop_lag_s=1.0 if hid == hive_ids[0] else 0.0)
+            for hid, nodes in sorted(by.items())]
+
+    ctl = placement.PlacementController(make_agent, assignment, plan,
+                                        signals_fn=rigged_signals)
+    try:
+        results = asyncio.run(asyncio.wait_for(ctl.run(), budget_s))
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    equal, settled, real = surviving_prefix_oracle(results)
+    moves = len(ctl.moves_applied)
+    out = {
+        "peers": n, "iterations": iterations, "moves": moves,
+        "chains_equal": equal, "settled_height": settled,
+        "real_blocks": real,
+    }
+    if moves:
+        out["migration_downtime_s"] = round(
+            sum(ctl.downtimes_s) / moves, 4)
+        out["downtime_max_s"] = round(max(ctl.downtimes_s), 4)
+        out["migration_bytes"] = int(sum(ctl.ticket_bytes) / moves)
+        out["ticket_bytes_max"] = max(ctl.ticket_bytes)
+    _progress(f"migration: {moves} moves, "
+              f"{out.get('migration_downtime_s', '-')}s/move, "
+              f"{out.get('migration_bytes', '-')}B/ticket, "
+              f"chains_equal={equal}")
+    return out
+
+
 def main():
     import jax
 
@@ -836,6 +918,11 @@ def main():
     # survived cell flips
     attack_matrix = bench_attack_matrix()
 
+    # migration-cost entry (ISSUE 19): per-move downtime + ticket bytes
+    # through the live placement controller at N=100 — the two
+    # lower-is-better keys bench_diff gates for the elastic fleet plane
+    migration = bench_migration()
+
     # device-crypto microbench (ISSUE 13): CPU vs device MSM across
     # intake widths {8, 35, 100} — the scaling evidence for the
     # accelerator-resident crypto plane
@@ -860,6 +947,7 @@ def main():
         "peer_density": density,
         "straggler_degradation": straggler,
         "attack_matrix": attack_matrix,
+        "migration": migration,
         "crypto_kernel": crypto_kernel,
     }
     # Full per-config detail goes to a file + stderr; stdout carries exactly
@@ -911,6 +999,11 @@ def main():
         # cells — a flipped survived cell is a bench_diff regression
         # (docs/ADVERSARY.md; full matrix in eval/results/)
         "attack_matrix": attack_matrix,
+        # migration cost (runtime/placement.py): mean per-move downtime
+        # + ticket bytes through the live controller at N=100 — a PR
+        # that makes moves slower or tickets fatter is a bench_diff
+        # regression (docs/PLACEMENT.md)
+        "migration": migration,
         # device-crypto microbench (crypto/kernels): CPU vs device MSM
         # across intake widths — the scaling evidence behind
         # --device-crypto (docs/CRYPTO_KERNELS.md)

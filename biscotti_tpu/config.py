@@ -23,6 +23,7 @@ from biscotti_tpu.ops.trust import TrustPlan
 from biscotti_tpu.runtime.admission import AdmissionPlan
 from biscotti_tpu.runtime.adversary import CAMPAIGNS, CampaignPlan
 from biscotti_tpu.runtime.faults import SLOW_PRESETS, FaultPlan
+from biscotti_tpu.runtime.placement import PlacementPlan
 
 
 class Defense(str, enum.Enum):
@@ -231,6 +232,15 @@ class BiscottiConfig:
     # any other defense no TrustLedger is constructed and verdicts are
     # bit-identical to the seed (guarded by tests/test_trust.py).
     trust_plan: TrustPlan = field(default_factory=TrustPlan)
+    # elastic fleet plane (runtime/placement.py, docs/PLACEMENT.md):
+    # load-aware placement of co-hosted peers — a seeded controller
+    # reads signals the planes already export (hive RSS/loop-lag
+    # gauges, admission shed rates, straggler profiles) and live-
+    # migrates peers between hives with chain, stake, breaker history
+    # and round position intact. Default = disabled: no controller is
+    # constructed, no biscotti_migration_* metric exists, and the seed
+    # schedule is bit-identical (guarded by tests/test_placement.py).
+    placement_plan: PlacementPlan = field(default_factory=PlacementPlan)
     # FoolsGold minimum mutually-similar cluster size for a rejection
     # (ops/robust_agg.py small-N fix): 3 stops N=10 honest pools from
     # mass-flagging accidental honest pairs; 1 restores pre-PR-16
@@ -462,6 +472,10 @@ class BiscottiConfig:
                 "campaigns adapt to the VRF election and chain state, "
                 "which the FedSys baseline does not have "
                 "(docs/ADVERSARY.md)")
+        # placement plane: an enabled plan with nonsensical cadence or
+        # thresholds must fail at construction, not at the controller's
+        # first decision point
+        self.placement_plan.validate()
         # adaptive defense plane: a nonsensical knob must fail at
         # construction, not on the first verifier decision; the ledger's
         # drift scorer and slow-trust ramp read the committed chain, so
@@ -947,6 +961,45 @@ class BiscottiConfig:
                             "emulation for mixed-version clusters and "
                             "rolling upgrades; -1 = current — "
                             "docs/PROTOCOL.md)")
+        p.add_argument("--placement", type=int,
+                       default=int(PlacementPlan.enabled),
+                       help="1 arms the elastic fleet plane: a seeded "
+                            "placement controller live-migrates peers "
+                            "off hot hives (docs/PLACEMENT.md); 0 = "
+                            "static placement, bit-identical")
+        p.add_argument("--placement-seed", type=int,
+                       default=PlacementPlan.seed,
+                       help="placement decision seed: same seed + same "
+                            "signals = the identical move schedule")
+        p.add_argument("--placement-interval", type=int,
+                       default=PlacementPlan.interval,
+                       help="anchor rounds between placement decisions")
+        p.add_argument("--placement-max-moves", type=int,
+                       default=PlacementPlan.max_moves,
+                       help="migrations applied per decision point")
+        p.add_argument("--placement-rss-hot", type=int,
+                       default=PlacementPlan.rss_hot_bytes,
+                       help="hive RSS bytes above which a host is hot "
+                            "(0 disarms the signal)")
+        p.add_argument("--placement-rss-drift-hot", type=int,
+                       default=PlacementPlan.rss_drift_hot_bytes,
+                       help="windowed hive RSS drift bytes above which "
+                            "a host is hot (leak shape; 0 disarms)")
+        p.add_argument("--placement-lag-hot-s", type=float,
+                       default=PlacementPlan.lag_hot_s,
+                       help="hive event-loop lag seconds above which a "
+                            "host is hot (0 disarms)")
+        p.add_argument("--placement-shed-hot", type=float,
+                       default=PlacementPlan.shed_hot,
+                       help="admission shed fraction above which a host "
+                            "is hot (0 disarms)")
+        p.add_argument("--placement-slow-hot", type=float,
+                       default=PlacementPlan.slow_hot,
+                       help="straggler compute-factor above which a "
+                            "host is hot (0 disarms)")
+        p.add_argument("--placement-min-hive-peers", type=int,
+                       default=PlacementPlan.min_hive_peers,
+                       help="never drain a hive below this many peers")
 
     @classmethod
     def from_args(cls, ns: argparse.Namespace) -> "BiscottiConfig":
@@ -1017,6 +1070,28 @@ class BiscottiConfig:
             trace=bool(getattr(ns, "trace", cls.trace)),
             protocol_version=getattr(ns, "protocol_version",
                                      cls.protocol_version),
+            placement_plan=PlacementPlan(
+                enabled=bool(getattr(ns, "placement",
+                                     PlacementPlan.enabled)),
+                seed=getattr(ns, "placement_seed", PlacementPlan.seed),
+                interval=getattr(ns, "placement_interval",
+                                 PlacementPlan.interval),
+                max_moves=getattr(ns, "placement_max_moves",
+                                  PlacementPlan.max_moves),
+                rss_hot_bytes=getattr(ns, "placement_rss_hot",
+                                      PlacementPlan.rss_hot_bytes),
+                rss_drift_hot_bytes=getattr(
+                    ns, "placement_rss_drift_hot",
+                    PlacementPlan.rss_drift_hot_bytes),
+                lag_hot_s=getattr(ns, "placement_lag_hot_s",
+                                  PlacementPlan.lag_hot_s),
+                shed_hot=getattr(ns, "placement_shed_hot",
+                                 PlacementPlan.shed_hot),
+                slow_hot=getattr(ns, "placement_slow_hot",
+                                 PlacementPlan.slow_hot),
+                min_hive_peers=getattr(ns, "placement_min_hive_peers",
+                                       PlacementPlan.min_hive_peers),
+            ),
             fault_plan=FaultPlan(
                 seed=getattr(ns, "fault_seed", FaultPlan.seed),
                 drop=getattr(ns, "fault_drop", FaultPlan.drop),

@@ -375,3 +375,55 @@ class AdmissionController:
                 "max_parked": self.plan.max_parked,
             },
         }
+
+    # --------------------------------------------------------- migration
+
+    def export_state(self) -> Dict[str, object]:
+        """Rate-governance state for a migration ticket
+        (runtime/placement.py): shed tallies + peaks (forensics survive
+        the move) and every token bucket's current fill, keyed by
+        "peer|class" strings so the export is JSON-clean. Inflight and
+        parked waiters are NOT exported — they are handler tasks, which
+        by definition die with the old incarnation."""
+        buckets = {}
+        for (peer, cls), b in self._buckets.items():
+            b._refill()
+            buckets[f"{peer}|{cls}"] = round(float(b.tokens), 6)
+        return {
+            "shed_counts": dict(self.shed_counts),
+            "inflight_peak": self.inflight_peak,
+            "parked_peak": self.parking.peak,
+            "parked_shed": self.parking.shed_count,
+            "buckets": buckets,
+        }
+
+    def restore_state(self, state: Dict[str, object]) -> None:
+        """Rehydrate an export: a flooder must not get a fresh burst
+        allowance just because its victim migrated — drained buckets
+        come back drained. Bucket keys whose peer id parses as an int
+        are restored under the int key (the runtime's peer key type);
+        anything else (overflow, peername tuples) restores under the
+        string, which the overflow path still matches."""
+        for reason, n in dict(state.get("shed_counts", {})).items():
+            self.shed_counts[reason] = (self.shed_counts.get(reason, 0)
+                                        + int(n))
+        self.inflight_peak = max(self.inflight_peak,
+                                 int(state.get("inflight_peak", 0)))
+        self.parking.peak = max(self.parking.peak,
+                                int(state.get("parked_peak", 0)))
+        self.parking.shed_count += int(state.get("parked_shed", 0))
+        for key, tokens in dict(state.get("buckets", {})).items():
+            peer_s, _, cls = key.rpartition("|")
+            try:
+                peer: object = int(peer_s)
+            except ValueError:
+                peer = peer_s
+            rate, burst = self.plan.class_rate(cls)
+            b = self._buckets.get((peer, cls))
+            if b is None:
+                if len(self._buckets) >= self.BUCKET_CAP:
+                    continue  # the overflow path re-limits organically
+                b = self._buckets[(peer, cls)] = TokenBucket(
+                    rate, burst, clock=self._clock)
+            b.tokens = min(b.burst, float(tokens))
+            b._last = self._clock()

@@ -115,12 +115,16 @@ class ChurnEvent:
 
     round: int
     node: int
-    kind: str  # KILL | RESTART | JOIN
+    kind: str  # KILL | RESTART | JOIN | MIGRATE
 
 
 KILL = "kill"
 RESTART = "restart"
 JOIN = "join"
+# live migration (runtime/placement.py, docs/PLACEMENT.md): the runner
+# captures a serialized ticket BEFORE the kill and relaunches the fresh
+# incarnation from it — unlike RESTART, state survives the move
+MIGRATE = "migrate"
 
 
 @dataclass(frozen=True)
@@ -601,3 +605,42 @@ class HealthLedger:
             }
             for pid, h in self._peers.items()
         }
+
+    def export_state(self) -> Dict[str, Dict[str, object]]:
+        """Full breaker state for a migration ticket (runtime/placement.py):
+        snapshot() plus the fields it elides because they only matter to a
+        LIVE ledger — the open timestamp (exported clock-RELATIVE, as the
+        age of the open, so a restore under a different clock re-anchors
+        it) and the probe slot. JSON-clean: keys are strings."""
+        now = self._clock()
+        out: Dict[str, Dict[str, object]] = {}
+        for pid, h in self._peers.items():
+            out[str(pid)] = {
+                "state": h.state, "failures": h.failures,
+                "opened_age_s": (round(now - h.opened_at, 6)
+                                 if h.state != CLOSED else 0.0),
+                "probing": h.probing, "opens": h.opens,
+                "closes": h.closes, "fast_fails": h.fast_fails,
+                "successes": h.successes,
+                "total_failures": h.total_failures,
+            }
+        return out
+
+    def restore_state(self, state: Dict[str, Dict[str, object]]) -> None:
+        """Rehydrate an export into THIS ledger (a migrated peer resumes
+        with its quarantine view intact: open breakers stay open with
+        their remaining cooldown, streaks and lifetime counters carry
+        over). Existing entries for the same peer are overwritten — the
+        ticket is the authority on the pre-move state."""
+        now = self._clock()
+        for pid_s, rec in state.items():
+            h = self._h(int(pid_s))
+            h.state = str(rec.get("state", CLOSED))
+            h.failures = int(rec.get("failures", 0))
+            h.opened_at = now - float(rec.get("opened_age_s", 0.0))
+            h.probing = bool(rec.get("probing", False))
+            h.opens = int(rec.get("opens", 0))
+            h.closes = int(rec.get("closes", 0))
+            h.fast_fails = int(rec.get("fast_fails", 0))
+            h.successes = int(rec.get("successes", 0))
+            h.total_failures = int(rec.get("total_failures", 0))

@@ -94,7 +94,8 @@ class ChurnRunner:
 
     def __init__(self, make_agent: Callable[[int], object],
                  num_nodes: int, schedule: List[faults.ChurnEvent],
-                 anchor: int = 0, poll_s: float = 0.1):
+                 anchor: int = 0, poll_s: float = 0.1,
+                 migrate_factory: Optional[Callable] = None):
         self.make_agent = make_agent
         self.num_nodes = num_nodes
         self.schedule = sorted(schedule,
@@ -102,6 +103,13 @@ class ChurnRunner:
         self.anchor = anchor
         self.poll_s = poll_s
         self.events_applied: List[Tuple[int, int, str]] = []
+        # MIGRATE events relaunch through this (node, ticket) factory so
+        # the fresh incarnation rehydrates from the serialized ticket
+        # (runtime/placement.py); without one, MIGRATE degrades to
+        # RESTART — real churn semantics, state lost — so a schedule
+        # built for a migration-aware harness still runs everywhere
+        self.migrate_factory = migrate_factory
+        self.migrations: List[Dict] = []
 
     async def _hard_kill(self, agent, task: asyncio.Task) -> None:
         task.cancel()
@@ -164,6 +172,31 @@ class ChurnRunner:
             task = tasks.get(ev.node)
             if task is not None and not task.done():
                 await self._hard_kill(agents[ev.node], task)
+        elif (ev.kind == faults.MIGRATE
+                and self.migrate_factory is not None):
+            # live migration (docs/PLACEMENT.md): serialize BEFORE the
+            # kill — the ticket is the only thing that survives the
+            # teardown — then relaunch from it; downtime spans capture
+            # through first schedulable relaunch, the window the bench
+            # `migration_downtime_s` key regresses on
+            import time as _time
+
+            from biscotti_tpu.runtime import placement
+
+            old = tasks.get(ev.node)
+            agent = agents.get(ev.node)
+            t0 = _time.monotonic()
+            ticket = (placement.ticket_from_agent(agent)
+                      if agent is not None else None)
+            if old is not None and not old.done():
+                await self._hard_kill(agent, old)
+            agents[ev.node] = self.migrate_factory(ev.node, ticket)
+            tasks[ev.node] = asyncio.ensure_future(agents[ev.node].run())
+            self.migrations.append({
+                "round": ev.round, "node": ev.node,
+                "downtime_s": round(_time.monotonic() - t0, 4),
+                "ticket_bytes": (placement.ticket_nbytes(ticket)
+                                 if ticket is not None else 0)})
         else:  # RESTART / JOIN: fresh agent, fresh incarnation
             old = tasks.get(ev.node)
             if old is not None and not old.done():

@@ -56,6 +56,7 @@ from biscotti_tpu.runtime import adversary
 from biscotti_tpu.runtime import codecs as wcodecs
 from biscotti_tpu.runtime import faults, rpc, wire
 from biscotti_tpu.runtime import overlay as ov
+from biscotti_tpu.runtime import placement
 from biscotti_tpu.runtime import protocol
 from biscotti_tpu.runtime import stragglers
 from biscotti_tpu.runtime.faults import CircuitOpenError
@@ -202,7 +203,8 @@ class RoundState:
 class PeerAgent:
     def __init__(self, cfg: BiscottiConfig, key_dir: str = "",
                  log_path: str = "", ckpt_dir: str = "", ckpt_every: int = 10,
-                 stepper=None, hive=None, light_trainer: bool = False):
+                 stepper=None, hive=None, light_trainer: bool = False,
+                 ticket: Optional[Dict] = None):
         self.cfg = cfg
         # peers-as-devices mode: a shared BatchStepper (or the hive's
         # HiveStepper) computes ALL local peers' SGD deltas in one
@@ -565,6 +567,21 @@ class PeerAgent:
             trustlib.TrustLedger(cfg.trust_plan, cfg.num_nodes)
             if cfg.defense == Defense.ENSEMBLE else None)
         self._verdict_stream: List[Dict] = []
+        # elastic fleet plane (runtime/placement.py, docs/PLACEMENT.md):
+        # GetMigrationTicket serves this peer's serialized state ONLY to
+        # a caller presenting the drain token its controller installed —
+        # None (the default) refuses every request, so an unmanaged peer
+        # cannot be drained (or have its EF residual read) over the wire
+        self._drain_token: Optional[str] = None
+        # genesis DKG deal intake (crypto/dkg.py): dealer id -> verified
+        # deal, populated by the DkgDeal RPC during a live ceremony
+        self._dkg_deals: Dict[int, object] = {}
+        if ticket is not None:
+            # migrated incarnation: rehydrate chain (through the guarded
+            # snapshot-adoption path), breaker ledger, admission buckets,
+            # EF residual, and round position from the controller's
+            # ticket — run() then announces and catches up live
+            placement.restore_agent(self, ticket)
 
     # ------------------------------------------------------------ utilities
 
@@ -1428,6 +1445,9 @@ class PeerAgent:
             "OverlayOffer": self._h_overlay_offer,
             "RegisterAggregate": self._h_register_aggregate,
             "RelayFrames": self._h_relay_frames,
+            # elastic fleet plane (docs/PLACEMENT.md)
+            "GetMigrationTicket": self._h_get_migration_ticket,
+            "DkgDeal": self._h_dkg_deal,
         }
         h = dispatch.get(msg_type)
         if h is None or not protocol.serves(self.caps, msg_type):
@@ -1647,6 +1667,56 @@ class PeerAgent:
                     base=cmeta["snapshot"]["base_height"],
                     blocks=len(suffix))
         return cmeta, carrays
+
+    # ------------------------------------- elastic fleet: migration, DKG
+
+    async def _h_get_migration_ticket(self, meta, arrays):
+        """Serve this peer's migration ticket to its placement
+        supervisor (docs/PLACEMENT.md). Token-gated and one-shot: the
+        supervisor installs a drain token on this agent out of band
+        (controller seam / supervisor process boundary) before asking;
+        any caller without it — which includes every ordinary peer,
+        since tickets carry the breaker ledger, admission buckets and
+        EF residual — gets a refusal, not state."""
+        token = str(meta.get("token", ""))
+        if not self._drain_token or token != self._drain_token:
+            raise RPCError("migration not authorized")
+        self._drain_token = None  # one-shot: a replayed drain is refused
+        ticket = placement.ticket_from_agent(self)
+        self._trace("migration_ticket_served",
+                    height=int(self.chain.latest.iteration),
+                    nbytes=placement.ticket_nbytes(ticket))
+        return placement.ticket_wire(ticket)
+
+    async def _h_dkg_deal(self, meta, arrays):
+        """Accept one dealer's genesis deal (crypto/dkg.py): rebuild
+        it, verify every share row against the dealer's own Pedersen
+        grid, and store it for ceremony aggregation. A failing deal is
+        a LOUD verdict — counted, traced, and reported back to the
+        dealer — never a silent drop, because aggregation excludes it
+        from the transcript and the dealer must learn why."""
+        from biscotti_tpu.crypto import dkg
+
+        dealer = int(meta.get("dealer_id", -1))
+        try:
+            deal = dkg.DkgDeal(
+                dealer_id=dealer,
+                comms=np.asarray(arrays["comms"], dtype=np.uint8),
+                xs=[int(x) for x in meta.get("xs", [])],
+                rows=np.asarray(arrays["rows"], dtype=np.int64),
+                blind_rows=np.asarray(arrays["blind_rows"],
+                                      dtype=np.uint8))
+            ok = dkg.verify_deal(deal)
+        except Exception:
+            ok = False
+        verdict = "verified" if ok else "rejected"
+        if ok:
+            self._dkg_deals[dealer] = deal
+        if self.tele.enabled:
+            self.tele.registry.counter(
+                dkg.DEALS_METRIC, dkg.DEALS_HELP).inc(verdict=verdict)
+        self._trace("dkg_deal", dealer=dealer, verdict=verdict)
+        return {"verdict": verdict, "dealer": dealer}
 
     async def _snapshot_bootstrap(self) -> bool:
         """Joiner half of the snapshot handshake: pull GetSnapshot from

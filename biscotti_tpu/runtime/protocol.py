@@ -40,7 +40,9 @@ TRACE = "trace"            # cross-peer span-context stamping (PR 12)
 BUSY = "busy"              # structured admission busy-status replies (PR 4)
 SNAPSHOT = "snapshot"      # pruned-chain snapshot bootstrap (PR 7)
 RELAY = "relay"            # overlay relay/aggregate frames (PR 11)
-PROTO = "proto"            # structured protocol-version advertisement (this PR)
+PROTO = "proto"            # structured protocol-version advertisement (PR 18)
+MIGRATE = "migrate"        # live-peer migration tickets (placement plane)
+DKG = "dkg"                # dealerless genesis deal exchange (crypto/dkg.py)
 
 # The grant of a peer on a pre-negotiation build (or a malformed hello).
 LEGACY_CAPS: FrozenSet[str] = wcodecs.RAW_CAPS
@@ -95,6 +97,8 @@ FEATURES: Dict[str, Feature] = _features([
     Feature(RELAY, 5, "overlay relay + aggregated subtree intake"),
     Feature(TRACE, 6, "cross-peer trace-context stamping"),
     Feature(PROTO, 7, "structured protocol-version advertisement"),
+    Feature(MIGRATE, 8, "live-peer migration ticket serving"),
+    Feature(DKG, 8, "dealerless genesis deal exchange"),
 ])
 
 MESSAGES: Dict[str, Message] = {m.name: m for m in [
@@ -120,6 +124,11 @@ MESSAGES: Dict[str, Message] = {m.name: m for m in [
     Message("OverlayOffer", 5, RELAY, "subtree share hand-off to the relay"),
     Message("RegisterAggregate", 5, RELAY, "summed subtree intake at the miner"),
     Message("RelayFrames", 5, RELAY, "verbatim frame relay across one tree hop"),
+    # --- version 8: elastic fleet plane (placement + genesis DKG) -------
+    Message("GetMigrationTicket", 8, MIGRATE,
+            "serialize a live peer for relocation (placement controller)"),
+    Message("DkgDeal", 8, DKG,
+            "Pedersen-committed genesis deal delivery/verification"),
 ]}
 
 CURRENT_VERSION: int = max(
@@ -158,7 +167,7 @@ def advertised(cfg) -> FrozenSet[str]:
         out |= {TRACE} & row
     if getattr(cfg, "overlay", False):
         out |= {RELAY} & row
-    out |= {BUSY, SNAPSHOT, PROTO} & row
+    out |= {BUSY, SNAPSHOT, PROTO, MIGRATE, DKG} & row
     return frozenset(out)
 
 

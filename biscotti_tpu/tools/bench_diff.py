@@ -48,11 +48,16 @@ from typing import Dict
 # regression even when latency still clears its gate). Thresholds are
 # the shared --threshold (+10% default): soak gates carry generous
 # absolute limits, so the diff's job is catching relative creep between
-# two soaks of the same scenario.
+# two soaks of the same scenario. The elastic-fleet pair
+# (`migration_downtime_s` / `migration_bytes`, bench.py's `migration`
+# entry, docs/PLACEMENT.md) already rides the `_s` / `_bytes` suffixes
+# — named explicitly so the contract survives a future suffix-rule
+# refactor: a PR that makes moves slower or tickets fatter regresses.
 DEFAULT_REGRESS = (r"(?<!points_per)(_s|_seconds|_secs|round_total|"
                    r"bytes_per_round|_bytes|crypto_s|final_error|"
                    r"failed|accepted_poisoned_n|rss_drift_bytes_per_h|"
-                   r"shed_rate|stall_rate)$")
+                   r"shed_rate|stall_rate|migration_downtime_s|"
+                   r"migration_bytes)$")
 
 
 def load_artifact(path: str) -> Dict:

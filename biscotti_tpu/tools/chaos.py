@@ -25,6 +25,15 @@ one seeded replayable run:
     python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 4 \
         --fault-seed 1 --slow 0.25 --slow-preset tee --adaptive-deadlines 1
 
+Migration scenario (docs/PLACEMENT.md): seeded-drawn peers are live-
+migrated mid-run — serialized to a placement ticket, hard-killed, and
+relaunched from the ticket with chain, stake, breaker ledger, and
+admission buckets intact — composing with churn/flood/slow/upgrade in
+one replayable run:
+
+    python -m biscotti_tpu.tools.chaos --nodes 4 --rounds 6 \
+        --migrate 2 --migrate-period 2 --churn 0.2
+
 Exit code 0 iff all peers finished with an equal settled chain prefix and
 at least one real (non-empty) block survived. The JSON report carries the
 per-peer fault tallies, retry/breaker counters, health snapshots, and
@@ -263,6 +272,20 @@ def main(argv=None) -> int:
                          "this historical protocol row (old-build "
                          "emulation, runtime/protocol.py; -1 = current "
                          "— docs/PROTOCOL.md)")
+    ap.add_argument("--migrate", type=int, default=0,
+                    help="live-migrate this many seeded-drawn non-anchor "
+                         "peers mid-run (runtime/placement.py ticket "
+                         "path: chain + stake + breaker ledger + "
+                         "admission buckets survive the move — unlike "
+                         "--churn restarts); the surviving-prefix "
+                         "oracle judges the whole timeline "
+                         "(docs/PLACEMENT.md)")
+    ap.add_argument("--migrate-period", type=int, default=2,
+                    help="anchor rounds between migrations")
+    ap.add_argument("--migrate-seed", type=int, default=-1,
+                    help="seed for the victim draw (default: "
+                         "--fault-seed) — same seed replays the "
+                         "identical move schedule")
     ap.add_argument("--rolling-upgrade", type=int, default=-1,
                     help="start every non-anchor peer pinned to this "
                          "protocol version row, then restart them "
@@ -389,6 +412,28 @@ def main(argv=None) -> int:
                      f"but the run stops at --rounds {ns.rounds}: raise "
                      f"--rounds or widen --upgrade-wave")
 
+    # seeded live-migration schedule (docs/PLACEMENT.md §replay): pure
+    # in --migrate-seed — one victim per --migrate-period anchor rounds,
+    # drawn from the non-anchor ids, so a failing move replays from the
+    # flags exactly like a fault plan
+    import random as _random
+
+    mseed = ns.fault_seed if ns.migrate_seed < 0 else ns.migrate_seed
+    migrate_planned: list = []
+    if ns.migrate > 0:
+        if ns.nodes < 2:
+            ap.error("--migrate needs >= 2 nodes (node 0 is the anchor)")
+        mperiod = max(1, ns.migrate_period)
+        last_at = mperiod * ns.migrate
+        if last_at >= ns.rounds:
+            ap.error(f"the last migration lands at round {last_at} but "
+                     f"the run stops at --rounds {ns.rounds}: raise "
+                     f"--rounds or shrink --migrate-period")
+        rng = _random.Random((mseed * 9973 + 17) & 0x7FFFFFFF)
+        for j in range(ns.migrate):
+            migrate_planned.append([mperiod * (j + 1),
+                                    rng.randrange(1, ns.nodes)])
+
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -401,6 +446,9 @@ def main(argv=None) -> int:
     for node, at in sorted(upgrade_round.items()):
         upgrade_events.append(_faults.ChurnEvent(round=at, node=node,
                                                  kind=_faults.RESTART))
+    migrate_events = [_faults.ChurnEvent(round=at, node=node,
+                                         kind=_faults.MIGRATE)
+                      for at, node in migrate_planned]
 
     churn_seed = ns.fault_seed if ns.churn_seed < 0 else ns.churn_seed
     # one plan: the frame-fault schedule keys off --fault-seed, the
@@ -513,20 +561,29 @@ def main(argv=None) -> int:
         made[i] = a  # latest incarnation; node 0 is never churned
         return a
 
-    if ns.churn > 0 or recycle_events or upgrade_events:
+    if ns.churn > 0 or recycle_events or upgrade_events or migrate_events:
         from biscotti_tpu.runtime.membership import (ChurnRunner,
                                                      surviving_prefix_oracle)
 
         schedule = sorted(
             plan.churn_schedule(ns.nodes, ns.rounds) + recycle_events
-            + upgrade_events,
+            + upgrade_events + migrate_events,
             key=lambda e: (e.round, e.node, e.kind))
 
-        async def go():
-            runner = ChurnRunner(make_agent, ns.nodes, schedule)
-            return await runner.run(), runner.events_applied
+        def migrate_agent(i, ticket):
+            # the migrated incarnation rehydrates from the ticket the
+            # runner captured before the kill (runtime/placement.py)
+            a = PeerAgent(cfg(i), ticket=ticket)
+            made[i] = a
+            return a
 
-        results, applied = asyncio.run(go())
+        async def go():
+            runner = ChurnRunner(make_agent, ns.nodes, schedule,
+                                 migrate_factory=migrate_agent)
+            res = await runner.run()
+            return res, runner.events_applied, runner.migrations
+
+        results, applied, moves_applied = asyncio.run(go())
         prefix_equal, common, real_blocks = surviving_prefix_oracle(results)
     else:
         async def go():
@@ -535,6 +592,7 @@ def main(argv=None) -> int:
 
         results = asyncio.run(go())
         applied = None
+        moves_applied = []
         prefix_equal, common, real_blocks = chain_oracle(results)
     faults_fired = tally_faults(results)
     # every robustness readout below comes off the telemetry snapshots —
@@ -610,6 +668,17 @@ def main(argv=None) -> int:
         } if ns.rolling_upgrade >= 0 else None),
         "protocol_pin": (ns.protocol_version
                          if ns.protocol_version >= 0 else None),
+        # live-migration timeline (docs/PLACEMENT.md): the seeded plan,
+        # the moves the runner actually applied (with per-move downtime
+        # and ticket bytes — the two bench/bench_diff regression keys),
+        # and how many incarnations confirmed a ticket restore
+        "migrations": ({
+            "count": ns.migrate, "period": max(1, ns.migrate_period),
+            "seed": mseed,
+            "planned": migrate_planned,
+            "applied": moves_applied,
+            "restored": cluster["counters"].get("migration_restored", 0),
+        } if ns.migrate > 0 else None),
         "slow": {"fraction": ns.slow, "node": ns.slow_node,
                  "factor": ns.slow_factor, "preset": ns.slow_preset,
                  "profiles": {

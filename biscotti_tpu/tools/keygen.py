@@ -1,4 +1,4 @@
-"""Bootstrap key dealer — the reference's offline keyGeneration step.
+"""Bootstrap key generation — dealerless DKG genesis, dealer as legacy.
 
 The reference's trusted dealer builds a commitment key of size = model dims
 from a secret MSM ladder and per-node bn256 keypairs, writing
@@ -6,18 +6,33 @@ from a secret MSM ladder and per-node bn256 keypairs, writing
 at startup (ref: keyGeneration/generateBootstrapFile.go:26-120,
 publicKey.go:26-61; consumed by DistSys/honest.go:760-871).
 
-This dealer is *transparent*: the commitment key is hash-derived from a
-public label (no dealer secret exists, strictly weaker trust assumption) and
-node identities are 32-byte seeds from OS randomness. Artifacts:
+Two genesis modes:
+
+* ``--genesis dkg`` (default) — the dealerless path (crypto/dkg.py,
+  docs/PLACEMENT.md §Genesis DKG): an N-party Pedersen-verifiable
+  ceremony where every party deals a Shamir-shared contribution under a
+  commitment grid and verifies every other deal before accepting; the
+  commitment-key label is derived from the ceremony transcript, so no
+  single party — and no dealer — sits in the trust path. Artifacts stay
+  format-compatible with the dealer's, plus ``genesis.json`` carrying
+  the transcript, per-dealer grid digests, and each node's joint share.
+* ``--genesis dealer`` — the LEGACY transparent-dealer path: one
+  process derives the commitment key from a static label and hands out
+  identity seeds. Kept only for compatibility and fast ephemeral test
+  clusters; it prints a loud legacy warning.
+
+Artifacts:
 
     commit_key.json   {"dims": d, "label": ..., "points": [hex, ...]}
     node_keys.json    {"<id>": {"schnorr_seed": hex, "vrf_roles_seed": hex,
                                 "vrf_noise_seed": hex, "schnorr_pub": hex,
                                 "vrf_roles_pub": hex, "vrf_noise_pub": hex}}
     peers.txt         host:port per line (ref: peersfile.txt shape)
+    genesis.json      (dkg only) ceremony transcript + joint shares
 
 Usage:  python -m biscotti_tpu.tools.keygen --dims 7850 --nodes 100 \
-            --out ./keys [--host 127.0.0.1 --base-port 8000]
+            --out ./keys [--genesis dkg|dealer] [--host 127.0.0.1 \
+            --base-port 8000]
 """
 
 from __future__ import annotations
@@ -32,14 +47,11 @@ from biscotti_tpu.crypto.commitments import CommitKey
 from biscotti_tpu.crypto.vrf import VRFKey
 
 
-def generate(dims: int, nodes: int, out_dir: str, host: str = "127.0.0.1",
-             base_port: int = 8000, label: str = "biscotti-tpu-v1") -> None:
-    os.makedirs(out_dir, exist_ok=True)
-
-    key = CommitKey.generate(dims, label.encode())
-    with open(os.path.join(out_dir, "commit_key.json"), "w") as f:
-        json.dump({"dims": dims, "label": label, "points": key.serialize()}, f)
-
+def _write_identity_and_peers(nodes: int, out_dir: str, host: str,
+                              base_port: int) -> None:
+    """Per-node identity seeds + the peers file — identical in both
+    genesis modes (identities are always drawn locally per node; only
+    the commitment-key trust path differs)."""
     node_keys = {}
     for i in range(nodes):
         schnorr_seed = secrets.token_bytes(32)
@@ -61,6 +73,62 @@ def generate(dims: int, nodes: int, out_dir: str, host: str = "127.0.0.1",
             f.write(f"{host}:{base_port + i}\n")
 
 
+def generate(dims: int, nodes: int, out_dir: str, host: str = "127.0.0.1",
+             base_port: int = 8000, label: str = "biscotti-tpu-v1") -> None:
+    """LEGACY dealer genesis: commitment key from a static label chosen
+    by whoever runs this process. Kept for compatibility and ephemeral
+    test clusters; `generate_dkg` is the trust-path replacement."""
+    os.makedirs(out_dir, exist_ok=True)
+
+    key = CommitKey.generate(dims, label.encode())
+    with open(os.path.join(out_dir, "commit_key.json"), "w") as f:
+        json.dump({"dims": dims, "label": label, "points": key.serialize()}, f)
+
+    _write_identity_and_peers(nodes, out_dir, host, base_port)
+
+
+def generate_dkg(dims: int, nodes: int, out_dir: str,
+                 host: str = "127.0.0.1", base_port: int = 8000,
+                 threshold: int = 0, rng_seed=None) -> dict:
+    """Dealerless genesis via the in-process DKG ceremony (crypto/dkg.py):
+    every node deals a Pedersen-committed contribution, verifies every
+    other deal, and the commitment-key label comes from the ceremony
+    transcript — no party picks it and no dealer ever exists. Returns
+    the genesis record it wrote (tests assert on it directly)."""
+    from biscotti_tpu.crypto import dkg
+
+    os.makedirs(out_dir, exist_ok=True)
+    k = int(threshold) or max(2, min(dkg.DKG_CHUNKS, (nodes // 2) + 1))
+    res = dkg.run_ceremony(nodes, k, rng_seed=rng_seed)
+    label = res.label
+    key = CommitKey.generate(dims, label.encode())
+    with open(os.path.join(out_dir, "commit_key.json"), "w") as f:
+        json.dump({"dims": dims, "label": label, "points": key.serialize()}, f)
+
+    accepted = [d for d in res.deals
+                if int(d.dealer_id) not in set(res.rejected)]
+    genesis = {
+        "genesis": "dkg",
+        "parties": nodes,
+        "threshold": k,
+        "transcript": res.transcript.hex(),
+        "label": label,
+        "rejected_dealers": sorted(res.rejected),
+        "deal_digests": {str(d.dealer_id): d.digest().hex()
+                         for d in accepted},
+        "shares": {str(s.party_id): {
+            "x": s.x,
+            "row": [int(v) for v in s.row],
+            "blind_row": s.blind_row.tobytes().hex(),
+        } for s in res.shares},
+    }
+    with open(os.path.join(out_dir, "genesis.json"), "w") as f:
+        json.dump(genesis, f, indent=1)
+
+    _write_identity_and_peers(nodes, out_dir, host, base_port)
+    return genesis
+
+
 def make_ephemeral_dir(dataset: str, nodes: int,
                        model_name: str = "") -> str:
     """Generate a dealer key dir in a fresh temp directory sized for this
@@ -73,8 +141,8 @@ def make_ephemeral_dir(dataset: str, nodes: int,
 
     dims = model_for_dataset(dataset, model_name or "").num_params
     out_dir = tempfile.mkdtemp(prefix="biscotti_keys_")
-    print(f"[keygen] dealer keys: dims={dims} nodes={nodes} -> {out_dir}",
-          file=sys.stderr)
+    print(f"[keygen] LEGACY dealer keys (ephemeral eval path): "
+          f"dims={dims} nodes={nodes} -> {out_dir}", file=sys.stderr)
     generate(dims=dims, nodes=nodes, out_dir=out_dir)
     return out_dir
 
@@ -111,6 +179,8 @@ def load_peers(out_dir: str) -> list:
 
 
 def main(argv=None) -> int:
+    import sys
+
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--dims", type=int, required=True,
                     help="model parameter count (commit key size)")
@@ -118,9 +188,31 @@ def main(argv=None) -> int:
     ap.add_argument("--out", required=True)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--base-port", type=int, default=8000)
+    ap.add_argument("--genesis", choices=("dkg", "dealer"), default="dkg",
+                    help="dkg: dealerless Pedersen-verifiable ceremony "
+                         "(default); dealer: LEGACY trusted-label path")
+    ap.add_argument("--dkg-threshold", type=int, default=0,
+                    help="ceremony recovery threshold (0 = derive from "
+                         "--nodes, capped for recovery cost)")
+    ap.add_argument("--dkg-seed", type=int, default=None,
+                    help="deterministic ceremony seed (replayable test "
+                         "ceremonies; omit for OS randomness)")
     args = ap.parse_args(argv)
-    generate(args.dims, args.nodes, args.out, args.host, args.base_port)
-    print(f"wrote commit_key.json, node_keys.json, peers.txt to {args.out}")
+    if args.genesis == "dealer":
+        print("[keygen] WARNING: --genesis dealer is the LEGACY "
+              "trusted-dealer path; the dealerless default is "
+              "--genesis dkg (docs/PLACEMENT.md)", file=sys.stderr)
+        generate(args.dims, args.nodes, args.out, args.host, args.base_port)
+        print(f"wrote commit_key.json, node_keys.json, peers.txt "
+              f"to {args.out}")
+    else:
+        g = generate_dkg(args.dims, args.nodes, args.out, args.host,
+                         args.base_port, threshold=args.dkg_threshold,
+                         rng_seed=args.dkg_seed)
+        print(f"wrote commit_key.json, node_keys.json, peers.txt, "
+              f"genesis.json to {args.out} "
+              f"(dkg transcript {g['transcript'][:16]}..., "
+              f"threshold {g['threshold']})")
     return 0
 
 

@@ -269,6 +269,29 @@ def merge_overlay(snaps: List[Dict]) -> Dict:
     return out
 
 
+def merge_placement(snaps: List[Dict]) -> Dict:
+    """Merge the elastic-fleet readouts (docs/PLACEMENT.md): which peers
+    rehydrated from a migration ticket this incarnation, how many drains
+    each peer served, and the genesis-DKG deal tallies by verdict (off
+    the `biscotti_dkg_deals_total` labels). The supervisor's own move
+    log lives in its summary (tools/pod_launch --supervise); this table
+    is the PEER-side evidence a scrape can see."""
+    out: Dict = {"migrated_in": [], "tickets_served": 0,
+                 "dkg_deals": {}}
+    for snap in snaps:
+        c = snap.get("counters") or {}
+        if c.get("migration_restored"):
+            out["migrated_in"].append(snap.get("node"))
+        out["tickets_served"] += int(c.get("migration_ticket_served", 0))
+        fam = (snap.get("metrics") or {}).get("biscotti_dkg_deals_total")
+        for row in (fam or {}).get("series", []):
+            v = row.get("labels", {}).get("verdict", "?")
+            out["dkg_deals"][v] = \
+                out["dkg_deals"].get(v, 0) + int(row.get("value", 0))
+    out["migrated_in"].sort(key=str)
+    return out
+
+
 def merge_campaign(snaps: List[Dict]) -> Dict:
     """Merge the adversary-campaign readouts (docs/ADVERSARY.md): which
     peers run which campaign, the summed action tallies, and the
@@ -397,6 +420,7 @@ def merge_snapshots(snaps: List[Dict]) -> Dict:
         "counters": counters,
         "wire": wire,
         "overlay": merge_overlay(snaps),
+        "placement": merge_placement(snaps),
         "campaign": merge_campaign(snaps),
         # streams stay out of the merged cluster table (bench artifacts
         # flatten its numeric leaves); the chaos report and the matrix
@@ -500,6 +524,15 @@ def format_table(merged: Dict) -> str:
                       + (f"   slow [{slow}]" if slow else "")
                       + (f"   deadlines [{dl}]" if dl else "")
                       + f"   [{strag['adaptive_peers']} peers adaptive]"]
+    plc = merged.get("placement") or {}
+    if (plc.get("migrated_in") or plc.get("tickets_served")
+            or plc.get("dkg_deals")):
+        deals = ", ".join(f"{k}={v}" for k, v in
+                          sorted(plc["dkg_deals"].items()))
+        lines += ["", "placement: migrated-in "
+                      f"{plc['migrated_in'] or '-'}   tickets served "
+                      f"{plc['tickets_served']}"
+                      + (f"   dkg deals [{deals}]" if deals else "")]
     camp = merged.get("campaign") or {}
     if camp.get("active"):
         who = ", ".join(f"{a['node']}:{a['campaign']}"

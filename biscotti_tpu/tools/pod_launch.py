@@ -29,6 +29,12 @@ import subprocess
 import sys
 import time
 
+# THE layout helper (runtime/placement.py, stdlib-only import): the
+# launcher, the supervisor, and the overlay contiguous-group assumption
+# all consume hive_layout/aligned_overlay_group, so a resized host
+# cannot silently break --overlay-group alignment
+from biscotti_tpu.runtime import placement
+
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -39,16 +45,17 @@ def read_hosts(path: str):
 
 def write_peers_file(hosts, nodes_per_host, base_port, out_path):
     """host:port per line, nodes_per_host consecutive ids per host
-    (ref: peersFileSent in runBiscotti.sh). Ports are base_port+global_id:
-    distinct hosts don't collide anyway, and a localhost-only fleet (every
-    'host' the same machine) still gets unique ports."""
+    (ref: peersFileSent in runBiscotti.sh) — the ranges come from the
+    SHARED layout helper, not private arithmetic. Ports are
+    base_port+global_id: distinct hosts don't collide anyway, and a
+    localhost-only fleet (every 'host' the same machine) still gets
+    unique ports."""
+    layout = placement.hive_layout(0, len(hosts), per_host=nodes_per_host)
     with open(out_path, "w") as f:
-        node_id = 0
-        for h in hosts:
+        for h, (start, count) in zip(hosts, layout):
             addr = "127.0.0.1" if h == "localhost" else h
-            for _ in range(nodes_per_host):
+            for node_id in range(start, start + count):
                 f.write(f"{addr}:{base_port + node_id}\n")
-                node_id += 1
 
 
 def committee_size(requested: int, total: int) -> int:
@@ -61,11 +68,14 @@ def committee_size(requested: int, total: int) -> int:
 
 
 def hive_cmd(args, start, count, total, peers_file, hive_id,
-             bind_ip="127.0.0.1"):
+             bind_ip="127.0.0.1", overlay_group=0):
     """One HIVE process hosting `count` co-hosted peers (runtime/hive.py,
     --peers-per-host mode): the single-process-per-peer model tops out
     around N=400 on one box; a hive per host carries hundreds of
-    lightweight peers on one JAX client + loopback transport."""
+    lightweight peers on one JAX client + loopback transport.
+    `overlay_group` is the layout-aligned subtree size from
+    `placement.aligned_overlay_group` (0: this host's own span — the
+    uniform-layout value the two coincide on)."""
     cmd = [sys.executable, "-m", "biscotti_tpu.runtime.hive",
            "-t", str(total),
            "-d", args.dataset, "-f", peers_file,
@@ -81,9 +91,11 @@ def hive_cmd(args, start, count, total, peers_file, hive_id,
            "--local", f"{start}:{count}",
            "--hive-id", hive_id]
     if getattr(args, "overlay", 0):
-        # the aggregation subtree = this launcher's per-host span, so
-        # the tree's interior level IS the hive host (docs/OVERLAY.md)
-        cmd += ["--overlay", "1", "--overlay-group", str(count)]
+        # the aggregation subtree = this launcher's per-host span (or the
+        # largest host-aligned divisor of an uneven layout), so the
+        # tree's interior level never straddles a host (docs/OVERLAY.md)
+        cmd += ["--overlay", "1",
+                "--overlay-group", str(overlay_group or count)]
     if args.key_dir:
         cmd += ["--key-dir", args.key_dir]
     return cmd
@@ -116,6 +128,127 @@ def cross_hive_equal(summaries):
                              for s in summaries)
 
 
+def placement_plan_from_args(args):
+    """The supervisor's PlacementPlan from the CLI knobs — seeded, so a
+    supervised run replays from its flags like a fault plan."""
+    return placement.PlacementPlan(
+        enabled=True,
+        seed=args.placement_seed,
+        interval=args.placement_interval,
+        max_moves=args.placement_max_moves,
+        rss_hot_bytes=args.placement_rss_hot,
+        lag_hot_s=args.placement_lag_hot_s,
+        shed_hot=args.placement_shed_hot,
+        slow_hot=args.placement_slow_hot,
+        min_hive_peers=args.placement_min_hive_peers)
+
+
+def supervise(args, hosts) -> int:
+    """Supervisor mode (--supervise; docs/PLACEMENT.md): the launcher
+    itself becomes the placement controller. Each hosts-file row backs
+    one hive (its own LoopbackHub + load readout) inside the
+    supervisor's process, sized by the SAME `placement.hive_layout` the
+    subprocess launcher uses; cross-hive traffic rides real TCP. At
+    every decision point the controller reads the per-hive signals and
+    live-migrates peers off hot hives — chain, breaker history,
+    admission buckets and round position riding the migration ticket.
+    All-localhost only: supervising remote hosts means scraping Metrics
+    and draining over GetMigrationTicket, which needs a remote respawn
+    channel this tool does not own."""
+    import asyncio
+
+    from biscotti_tpu.config import BiscottiConfig, Defense
+    from biscotti_tpu.runtime.hive import LoopbackHub, rss_bytes
+    from biscotti_tpu.runtime.membership import surviving_prefix_oracle
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    if any(h != "localhost" for h in hosts):
+        print("[pod] --supervise drives hives in-process and needs an "
+              "all-localhost hosts file", file=sys.stderr)
+        return 2
+    per = args.peers_per_host
+    if not per:
+        print("[pod] --supervise requires --peers-per-host (hive mode)",
+              file=sys.stderr)
+        return 2
+    layout = placement.hive_layout(0, len(hosts), per_host=per)
+    total = sum(c for _, c in layout)
+    write_peers_file(hosts, per, args.base_port, args.peers_file)
+    plan = placement_plan_from_args(args)
+    cfg_base = BiscottiConfig(
+        num_nodes=total, dataset=args.dataset,
+        peers_file=args.peers_file, base_port=args.base_port,
+        secure_agg=bool(args.secure_agg), noising=bool(args.noising),
+        verification=bool(args.verification),
+        num_miners=committee_size(args.num_miners, total),
+        num_verifiers=committee_size(args.num_verifiers, total),
+        num_noisers=committee_size(args.num_noisers, total),
+        max_iterations=args.iterations, convergence_error=0.0,
+        seed=args.seed, placement_plan=plan,
+        overlay=bool(args.overlay),
+        overlay_group=(placement.aligned_overlay_group(layout)
+                       if args.overlay else 0))
+    cfg_base = cfg_base.replace(timeouts=cfg_base.timeouts.scaled(
+        cfg_base.num_nodes, cfg_base.num_verifiers, cfg_base.num_miners,
+        random_sampling=cfg_base.random_sampling,
+        defense_is_krum=cfg_base.defense == Defense.KRUM))
+
+    hive_ids = [f"host{i}" for i in range(len(hosts))]
+    hubs = {hid: LoopbackHub() for hid in hive_ids}
+    infos = {hid: {"id": hid, "peers": count, "rss_bytes": 0,
+                   "rss_peak_bytes": 0, "loop_lag_s": 0.0,
+                   "rss_drift_bytes": 0, "loop_lag_drift_s": 0.0}
+             for hid, (_, count) in zip(hive_ids, layout)}
+    assignment = {node: hid
+                  for hid, (start, count) in zip(hive_ids, layout)
+                  for node in range(start, start + count)}
+
+    def make_agent(node, hive_id, ticket):
+        cfg = cfg_base.replace(node_id=node)
+        a = PeerAgent(cfg, key_dir=args.key_dir, hive=hubs[hive_id],
+                      ticket=ticket)
+        a.hive_info = infos[hive_id]
+        return a
+
+    ctl = placement.PlacementController(make_agent, assignment, plan)
+
+    async def _monitor(period: float = 0.25) -> None:
+        # one process hosts every hive, so RSS is a shared readout; the
+        # per-hive differentiation comes from shed rates and straggler
+        # profiles (placement.default_signals)
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(period)
+            lag = round(max(0.0, loop.time() - t0 - period), 4)
+            rss = rss_bytes()
+            for info in infos.values():
+                info["loop_lag_s"] = lag
+                info["rss_bytes"] = rss
+
+    async def _run():
+        mon = asyncio.get_running_loop().create_task(_monitor())
+        try:
+            return await ctl.run()
+        finally:
+            mon.cancel()
+
+    t0 = time.time()
+    results = asyncio.run(_run())
+    wall = time.time() - t0
+    equal, settled, real = surviving_prefix_oracle(results)
+    summary = {
+        "supervised": True, "total_nodes": total, "hosts": len(hosts),
+        "hive_mode": True, "peers_per_host": per,
+        "chains_equal": equal, "settled_height": settled,
+        "real_blocks": real,
+        "s_per_iter": round(wall / max(1, args.iterations), 3),
+        "placement": ctl.summary(),
+    }
+    print(json.dumps(summary))
+    return 0 if equal and real >= 1 else 1
+
+
 def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
     cmd = [sys.executable, "-m", "biscotti_tpu.runtime.peer",
            "-i", str(node_id), "-t", str(total),
@@ -131,7 +264,10 @@ def peer_cmd(args, node_id, total, peers_file, bind_ip="127.0.0.1"):
            "--seed", str(args.seed)]
     if getattr(args, "overlay", 0):
         per = args.peers_per_host or args.nodes_per_host
-        cmd += ["--overlay", "1", "--overlay-group", str(per)]
+        layout = placement.hive_layout(0, 1, per_host=per)
+        cmd += ["--overlay", "1",
+                "--overlay-group",
+                str(placement.aligned_overlay_group(layout))]
     if args.key_dir:
         cmd += ["--key-dir", args.key_dir]
     return cmd
@@ -163,6 +299,29 @@ def main(argv=None) -> int:
     ap.add_argument("--num-verifiers", type=int, default=3)
     ap.add_argument("--num-noisers", type=int, default=2)
     ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--supervise", type=int, default=0,
+                    help="1: run the elastic-fleet supervisor instead of "
+                         "detached subprocesses — one in-process hive per "
+                         "hosts-file row, a seeded placement controller "
+                         "live-migrating peers off hot hives "
+                         "(docs/PLACEMENT.md; all-localhost hive mode)")
+    ap.add_argument("--placement-seed", type=int,
+                    default=placement.PlacementPlan.seed)
+    ap.add_argument("--placement-interval", type=int,
+                    default=placement.PlacementPlan.interval,
+                    help="anchor rounds between placement decisions")
+    ap.add_argument("--placement-max-moves", type=int,
+                    default=placement.PlacementPlan.max_moves)
+    ap.add_argument("--placement-rss-hot", type=int,
+                    default=placement.PlacementPlan.rss_hot_bytes)
+    ap.add_argument("--placement-lag-hot-s", type=float,
+                    default=placement.PlacementPlan.lag_hot_s)
+    ap.add_argument("--placement-shed-hot", type=float,
+                    default=placement.PlacementPlan.shed_hot)
+    ap.add_argument("--placement-slow-hot", type=float,
+                    default=placement.PlacementPlan.slow_hot)
+    ap.add_argument("--placement-min-hive-peers", type=int,
+                    default=placement.PlacementPlan.min_hive_peers)
     ap.add_argument("--peers-file", default="/tmp/biscotti_peers.txt")
     ap.add_argument("--dry-run", action="store_true")
     ap.add_argument("--timeout", type=float, default=900.0)
@@ -176,8 +335,12 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     hosts = read_hosts(args.hosts)
+    if args.supervise:
+        return supervise(args, hosts)
     per_host = args.peers_per_host or args.nodes_per_host
-    total = len(hosts) * per_host
+    layout = placement.hive_layout(0, len(hosts), per_host=per_host)
+    total = sum(c for _, c in layout)
+    aligned_group = placement.aligned_overlay_group(layout)
     write_peers_file(hosts, per_host, args.base_port,
                      args.peers_file)
 
@@ -225,19 +388,17 @@ def main(argv=None) -> int:
                     stderr=subprocess.DEVNULL, text=True)))
 
     procs = []
-    node_id = 0
-    for hi, h in enumerate(hosts):
+    for hi, (h, (start, count)) in enumerate(zip(hosts, layout)):
         bind_ip = "127.0.0.1" if h == "localhost" else "0.0.0.0"
         if args.peers_per_host:
-            # hive mode: one process per HOST, co-hosting per_host peers
-            launch(hi, h, hive_cmd(args, node_id, per_host, total,
-                                   args.peers_file, f"hive{hi}", bind_ip))
-            node_id += per_host
+            # hive mode: one process per HOST, co-hosting its layout span
+            launch(hi, h, hive_cmd(args, start, count, total,
+                                   args.peers_file, f"hive{hi}", bind_ip,
+                                   overlay_group=aligned_group))
         else:
-            for _ in range(per_host):
+            for node_id in range(start, start + count):
                 launch(node_id, h, peer_cmd(args, node_id, total,
                                             args.peers_file, bind_ip))
-                node_id += 1
     if args.dry_run:
         print(json.dumps({"dry_run": True, "total_nodes": total,
                           "hosts": len(hosts),

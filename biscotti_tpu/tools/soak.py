@@ -15,6 +15,15 @@ from ``--seed + cycle``, so any failing cycle replays standalone through
 anchor's height transitions (the per-round latency series the p99 gate
 reads) and samples process RSS every ``--sample-s``.
 
+``--migrations-per-cycle`` live-migrates seeded-drawn peers mid-cycle
+through the placement ticket path (state survives the move,
+docs/PLACEMENT.md) and ``--rolling-upgrade`` starts each cycle's
+non-anchor fleet on a historical protocol row and restarts it
+wave-by-wave onto the current build mid-cycle (docs/PROTOCOL.md) — so
+endurance cycles exercise rebalance + upgrade under churn. The gate
+verdicts are unchanged; the scenario (including the drill knobs) is
+echoed in the artifact.
+
 SLO gates (lower is better, every limit CLI-overridable; the keys are
 named so ``tools/bench_diff`` regresses two soak artifacts out of the
 box — its DEFAULT_REGRESS covers all five):
@@ -39,6 +48,7 @@ import argparse
 import asyncio
 import json
 import math
+import random
 import time
 from typing import Dict, List, Tuple
 
@@ -98,6 +108,24 @@ def main(argv=None) -> int:
     ap.add_argument("--slow-preset", default="bimodal",
                     choices=["", "tee", "bimodal", "longtail"])
     ap.add_argument("--fault-drop", type=float, default=0.05)
+    ap.add_argument("--migrations-per-cycle", type=int, default=0,
+                    help="live-migrate this many seeded-drawn peers per "
+                         "cycle (runtime/placement.py ticket path — "
+                         "state survives the move, unlike churn "
+                         "restarts), spread evenly across the cycle's "
+                         "rounds; gate verdicts unchanged "
+                         "(docs/PLACEMENT.md)")
+    ap.add_argument("--rolling-upgrade", type=int, default=-1,
+                    help="start every non-anchor peer pinned to this "
+                         "historical protocol row EACH cycle, then "
+                         "restart them wave-by-wave onto the current "
+                         "build mid-cycle (docs/PROTOCOL.md) — so "
+                         "endurance cycles soak the mixed-version span "
+                         "under churn; -1 disables")
+    ap.add_argument("--upgrade-period", type=int, default=3,
+                    help="rounds between rolling-upgrade waves")
+    ap.add_argument("--upgrade-wave", type=int, default=2,
+                    help="peers restarted per rolling-upgrade wave")
     ap.add_argument("--sample-s", type=float, default=5.0,
                     help="RSS sampling interval")
     ap.add_argument("--out", default="",
@@ -124,12 +152,40 @@ def main(argv=None) -> int:
                     help="straggler round-stalls per round limit")
     ns = ap.parse_args(argv)
 
+    # mid-cycle rolling-upgrade waves (docs/PROTOCOL.md): same shape as
+    # tools/chaos --rolling-upgrade, validated before any cycle launches
+    # — a no-op or truncated drill must refuse, not soak mislabeled
+    from biscotti_tpu.runtime import protocol
+
+    upgrade_round: Dict[int, int] = {}
+    upgrade_waves: List[List] = []
+    if ns.rolling_upgrade >= 0:
+        if not 0 <= ns.rolling_upgrade < protocol.CURRENT_VERSION:
+            ap.error(f"--rolling-upgrade {ns.rolling_upgrade} must be a "
+                     f"historical row in "
+                     f"0..{protocol.CURRENT_VERSION - 1}")
+        wave = max(1, ns.upgrade_wave)
+        targets = list(range(1, ns.nodes))
+        for w in range(0, len(targets), wave):
+            at = ns.upgrade_period * (w // wave + 1)
+            upgrade_waves.append([at, targets[w:w + wave]])
+            for node in targets[w:w + wave]:
+                upgrade_round[node] = at
+        if upgrade_waves[-1][0] >= ns.rounds:
+            ap.error(f"rolling upgrade's last wave lands at round "
+                     f"{upgrade_waves[-1][0]} but each cycle stops at "
+                     f"--rounds {ns.rounds}: raise --rounds or widen "
+                     f"--upgrade-wave")
+    if ns.migrations_per_cycle >= ns.rounds:
+        ap.error(f"--migrations-per-cycle {ns.migrations_per_cycle} "
+                 f"cannot fit inside --rounds {ns.rounds}")
+
     import jax
 
     jax.config.update("jax_enable_x64", True)
 
     from biscotti_tpu.config import BiscottiConfig, Defense, Timeouts
-    from biscotti_tpu.runtime import adversary, hive
+    from biscotti_tpu.runtime import adversary, faults, hive
     from biscotti_tpu.runtime.admission import AdmissionPlan
     from biscotti_tpu.runtime.faults import FaultPlan
     from biscotti_tpu.runtime.membership import (ChurnRunner,
@@ -171,8 +227,18 @@ def main(argv=None) -> int:
 
         made: Dict[int, PeerAgent] = {}
 
-        def make_agent(i: int) -> PeerAgent:
-            a = PeerAgent(BiscottiConfig(
+        def _cfg(i: int) -> BiscottiConfig:
+            # under --rolling-upgrade a non-anchor peer speaks the old
+            # row until its wave has fired at the anchor — any relaunch
+            # from that point on (upgrade restart, churn restart, or a
+            # migration) comes up on the current build, exactly like a
+            # supervisor rolling a new binary (tools/chaos does the same)
+            pin = -1
+            if ns.rolling_upgrade >= 0 and i != 0:
+                height = made[0].iteration if 0 in made else 0
+                pin = (ns.rolling_upgrade
+                       if height < upgrade_round.get(i, 0) else -1)
+            return BiscottiConfig(
                 node_id=i, num_nodes=ns.nodes, dataset=ns.dataset,
                 base_port=base_port, num_verifiers=1, num_miners=1,
                 num_noisers=1, secure_agg=bool(ns.secure_agg),
@@ -181,12 +247,40 @@ def main(argv=None) -> int:
                 sample_percent=1.0, batch_size=8, timeouts=fast,
                 seed=seed, fault_plan=plan, admission_plan=admission,
                 campaign_plan=camp, adaptive_deadlines=True,
-                wire_codec=ns.codec))
+                protocol_version=pin, wire_codec=ns.codec)
+
+        def make_agent(i: int) -> PeerAgent:
+            a = PeerAgent(_cfg(i))
             made[i] = a
             return a
 
-        schedule = plan.churn_schedule(ns.nodes, ns.rounds)
-        runner = ChurnRunner(make_agent, ns.nodes, schedule)
+        def migrate_agent(i: int, ticket) -> PeerAgent:
+            a = PeerAgent(_cfg(i), ticket=ticket)
+            made[i] = a
+            return a
+
+        # per-cycle migration schedule (docs/PLACEMENT.md §replay):
+        # seeded in the CYCLE seed like every other plan, victims drawn
+        # from the non-anchor ids, moves spread evenly across the rounds
+        migrate_events = []
+        if ns.migrations_per_cycle > 0:
+            rng = random.Random((seed * 9973 + 17) & 0x7FFFFFFF)
+            mperiod = max(1, ns.rounds // (ns.migrations_per_cycle + 1))
+            migrate_events = [
+                faults.ChurnEvent(round=mperiod * (j + 1),
+                                  node=rng.randrange(1, ns.nodes),
+                                  kind=faults.MIGRATE)
+                for j in range(ns.migrations_per_cycle)]
+        upgrade_events = [
+            faults.ChurnEvent(round=at, node=node, kind=faults.RESTART)
+            for node, at in sorted(upgrade_round.items())]
+
+        schedule = sorted(
+            plan.churn_schedule(ns.nodes, ns.rounds) + migrate_events
+            + upgrade_events,
+            key=lambda e: (e.round, e.node, e.kind))
+        runner = ChurnRunner(make_agent, ns.nodes, schedule,
+                             migrate_factory=migrate_agent)
         task = asyncio.ensure_future(runner.run())
         # anchor-height poller: one latency sample per crossed round
         # (0.25 s resolution — the same cadence the hive monitor uses)
@@ -223,6 +317,15 @@ def main(argv=None) -> int:
             "sheds": merged["admission"]["shed_total"],
             "stalls": merged["stragglers"]["stalls_total"],
             "churn_events_applied": len(runner.events_applied),
+            # elastic-fleet drills (docs/PLACEMENT.md, docs/PROTOCOL.md):
+            # per-move downtime/ticket-bytes, restore confirmations, and
+            # the upgrade restarts that actually landed this cycle
+            "migrations": runner.migrations,
+            "migrations_restored": merged["counters"].get(
+                "migration_restored", 0),
+            "upgrades_applied": [
+                [r, n] for (r, n, k) in runner.events_applied
+                if k == faults.RESTART and upgrade_round.get(n) == r],
             "faults": {k: v for k, v in sorted(
                 merged.get("faults", {}).items())},
         }
@@ -268,6 +371,11 @@ def main(argv=None) -> int:
             "campaign_node": ns.campaign_node,
             "slow": ns.slow, "slow_preset": ns.slow_preset,
             "fault_drop": ns.fault_drop,
+            "migrations_per_cycle": ns.migrations_per_cycle,
+            "rolling_upgrade": ns.rolling_upgrade,
+            "upgrade_period": ns.upgrade_period,
+            "upgrade_wave": ns.upgrade_wave,
+            "upgrade_waves": upgrade_waves,
         },
         "cycles_run": len(cycles),
         "settled_rounds": total_rounds,

@@ -24,16 +24,20 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", os.environ["JAX_ENABLE_X64"] == "1")
 
 
-async def wait_until(cond, budget: float = 120.0, what: str = ""):
+async def wait_until(cond, budget: float = 120.0, what: str = "",
+                     poll: float = 0.05):
     """Shared condition-driven wait for the live-TCP suites (import with
     `from conftest import wait_until`): the de-flaked replacement for
     fixed-height/wall-clock waits (load-flaky, CHANGES PR 4/6) — a test
     advances the moment the OBSERVABLE state it needs appears, with the
-    budget only as a generous backstop a loaded box stretches into."""
+    budget only as a generous backstop a loaded box stretches into.
+    Pass poll=0 to react at event-loop granularity — required when the
+    waiter must act INSIDE the round the condition marks (a warm suite
+    finishes a whole round in less than the default poll interval)."""
     import asyncio
 
     loop = asyncio.get_event_loop()
     deadline = loop.time() + budget
     while not cond():
         assert loop.time() < deadline, f"timeout waiting for {what}"
-        await asyncio.sleep(0.05)
+        await asyncio.sleep(poll)

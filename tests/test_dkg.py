@@ -1,0 +1,278 @@
+"""Dealerless genesis DKG (crypto/dkg.py, docs/PLACEMENT.md §Genesis DKG).
+
+The acceptance gate for ISSUE 19's genesis half: commitment
+verification, Shamir recovery, and corrupted-deal rejection all proven
+here, plus the end-to-end claim — `tools/keygen --genesis dkg` writes a
+key_dir a keyed cluster actually boots from, with the commitment-key
+label derived from the ceremony transcript rather than picked by any
+party. The dealer path survives only as the explicitly-labeled legacy
+mode (tests/test_keyed_cluster.py still covers it)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from biscotti_tpu.crypto import dkg
+
+pytestmark = pytest.mark.dkg
+
+N = 5
+K = 3
+
+
+@pytest.fixture(scope="module")
+def ceremony():
+    return dkg.run_ceremony(N, K, rng_seed=7)
+
+
+# ------------------------------------------------------------ deals
+
+
+def test_contribute_is_seeded_and_verifiable():
+    xs = dkg.share_points(4)
+    a = dkg.contribute(0, xs, 2, b"seed-A" * 6)
+    b = dkg.contribute(0, xs, 2, b"seed-A" * 6)
+    c = dkg.contribute(0, xs, 2, b"seed-B" * 6)
+    # replayable: same dealer seed, same deal — different seed, different grid
+    assert a.digest() == b.digest()
+    assert a.digest() != c.digest()
+    assert np.array_equal(a.rows, b.rows)
+    assert dkg.verify_deal(a) and dkg.verify_deal(c)
+    # the grid shape carries (chunks, threshold) in the open
+    assert a.comms.shape == (dkg.DKG_CHUNKS, 2, 64)
+    assert a.rows.shape == (4, dkg.DKG_CHUNKS)
+
+
+def test_contribute_refuses_degenerate_ceremonies():
+    with pytest.raises(ValueError, match="threshold must be >= 2"):
+        dkg.contribute(0, [1, 2, 3], 1, b"s" * 32)
+    with pytest.raises(ValueError, match="cannot hold"):
+        dkg.contribute(0, [1, 2], 3, b"s" * 32)
+    with pytest.raises(ValueError, match="distinct and nonzero"):
+        dkg.contribute(0, [0, 1, 2], 2, b"s" * 32)
+
+
+def test_corrupted_deal_is_rejected_and_excluded():
+    """The corrupted-deal rejection the acceptance gate demands: a share
+    row inconsistent with the dealer's own Pedersen grid fails
+    `verify_deal`, and `aggregate` excludes that dealer LOUDLY (its id
+    lands in `reject`) instead of silently summing a share that opens
+    nothing."""
+    res = dkg.run_ceremony(N, K, rng_seed=21)
+    deals = list(res.deals)
+    evil = deals[2]
+    evil.rows = evil.rows.copy()
+    evil.rows[1, 0] += 1  # one perturbed share value for party 1
+    assert not dkg.verify_deal(evil)
+
+    rejected = []
+    shares = dkg.aggregate(deals, reject=rejected)
+    assert rejected == [2]
+    assert all(s.dealers == [0, 1, 3, 4] for s in shares)
+    assert all(s.verify() for s in shares)
+    # the transcript (and hence the commit-key label) is computed over
+    # the ACCEPTED set only, so excluding a dealer changes the label —
+    # a cluster keyed by the poisoned ceremony cannot interoperate with
+    # one keyed by the clean ceremony
+    clean = dkg.run_ceremony(N, K, rng_seed=21)
+    accepted = [d for d in deals if d.dealer_id != 2]
+    assert dkg.commit_key_label(accepted) != clean.label
+
+
+def test_all_deals_corrupt_raises():
+    xs = dkg.share_points(3)
+    deal = dkg.contribute(0, xs, 2, b"x" * 32)
+    deal.rows = deal.rows + 1
+    with pytest.raises(ValueError, match="no verifiable deals"):
+        dkg.aggregate([deal])
+
+
+# ----------------------------------------------- aggregation + recovery
+
+
+def test_ceremony_shares_verify_against_joint_grid(ceremony):
+    """Commitment verification, holder side: every party's joint share
+    opens the SUMMED Pedersen grid (the homomorphism the whole plane
+    rests on — no party ever reconstructs to check)."""
+    assert ceremony.rejected == []
+    assert len(ceremony.shares) == N
+    for s in ceremony.shares:
+        assert s.verify()
+        assert s.x == s.party_id + 1
+        assert s.dealers == list(range(N))
+    # tampered holder state fails the same check
+    bad = dkg.DkgShare(party_id=0, x=1,
+                       row=ceremony.shares[0].row + 1,
+                       blind_row=ceremony.shares[0].blind_row,
+                       joint_comms=ceremony.shares[0].joint_comms,
+                       dealers=ceremony.shares[0].dealers)
+    assert not bad.verify()
+
+
+def test_threshold_recovery_any_quorum_same_secret(ceremony):
+    """Shamir recovery: ANY >= threshold holders recover the same joint
+    secret; below threshold is refused; and the recovered constant term
+    is bounded by the per-dealer contribution bound (sum of N bounded
+    contributions)."""
+    a = dkg.recover_secret(ceremony.shares[:K], K)
+    b = dkg.recover_secret(ceremony.shares[-K:], K)
+    c = dkg.recover_secret(ceremony.shares, K)  # over-quorum also fine
+    assert np.array_equal(a, b) and np.array_equal(a, c)
+    assert a.shape == (dkg.DKG_CHUNKS,)
+    assert np.all(np.abs(a) <= N * dkg.SECRET_BOUND)
+    assert dkg.secret_digest(a) == dkg.secret_digest(b)
+    with pytest.raises(ValueError, match="below the ceremony threshold"):
+        dkg.recover_secret(ceremony.shares[:K - 1], K)
+
+
+def test_corrupted_share_recovery_detected(ceremony):
+    """The integrality corruption detector: a perturbed holder row makes
+    some interpolated coefficient non-integer and recovery raises —
+    never silently absorbs a corrupt holder."""
+    shares = [dkg.DkgShare(party_id=s.party_id, x=s.x, row=s.row.copy(),
+                           blind_row=s.blind_row,
+                           joint_comms=s.joint_comms, dealers=s.dealers)
+              for s in ceremony.shares[:K]]
+    shares[0].row[3] += 1
+    with pytest.raises(ValueError):
+        dkg.recover_secret(shares, K)
+
+
+def test_transcript_binds_label(ceremony):
+    """No party picks the commitment-key label: it is a pure function of
+    every accepted deal, so two different ceremonies derive different
+    generator ladders and cannot silently interoperate."""
+    assert ceremony.label.startswith("biscotti-dkg-v1:")
+    assert ceremony.label == f"biscotti-dkg-v1:{ceremony.transcript.hex()}"
+    other = dkg.run_ceremony(N, K, rng_seed=8)
+    assert other.label != ceremony.label
+    # transcript is order-independent (sorted by dealer id)
+    assert dkg.transcript_hash(list(reversed(ceremony.deals))) \
+        == ceremony.transcript
+
+
+# --------------------------------------------------- live RPC intake
+
+
+def test_dkg_deal_rpc_verdicts_and_metric():
+    """The DkgDeal RPC handler (protocol v8, `dkg` feature): a verified
+    deal is stored for aggregation, a corrupted one answers
+    `{"verdict": "rejected"}` and counts
+    `biscotti_dkg_deals_total{verdict=rejected}` — loud, never a silent
+    drop."""
+    from biscotti_tpu.config import BiscottiConfig
+    from biscotti_tpu.runtime.peer import PeerAgent
+
+    cfg = BiscottiConfig(
+        node_id=0, num_nodes=3, dataset="creditcard", base_port=15930,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=1, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, seed=3)
+    agent = PeerAgent(cfg)
+    xs = dkg.share_points(3)
+    good = dkg.contribute(1, xs, 2, b"rpc-good" * 4)
+    evil = dkg.contribute(2, xs, 2, b"rpc-evil" * 4)
+    evil.rows = evil.rows.copy()
+    evil.rows[0, 0] += 1
+
+    def wire(deal):
+        return ({"dealer_id": deal.dealer_id, "xs": deal.xs},
+                {"comms": deal.comms, "rows": deal.rows,
+                 "blind_rows": deal.blind_rows})
+
+    async def go():
+        m1 = await agent._h_dkg_deal(*wire(good))
+        m2 = await agent._h_dkg_deal(*wire(evil))
+        return m1, m2
+
+    try:
+        m1, m2 = asyncio.run(go())
+        assert m1 == {"verdict": "verified", "dealer": 1}
+        assert m2 == {"verdict": "rejected", "dealer": 2}
+        assert list(agent._dkg_deals) == [1]
+        assert agent.counters.get("dkg_deal", 0) == 2
+        fam = (agent.telemetry_snapshot().get("metrics") or {}).get(
+            dkg.DEALS_METRIC)
+        verdicts = {row["labels"]["verdict"]: row["value"]
+                    for row in (fam or {}).get("series", [])}
+        assert verdicts == {"verified": 1.0, "rejected": 1.0}
+    finally:
+        agent.pool.close()
+        agent.server.close_now()
+
+
+# ------------------------------------------------- keygen + cluster boot
+
+
+def test_keygen_dkg_genesis_record(tmp_path):
+    from biscotti_tpu.tools import keygen
+
+    out = str(tmp_path / "keys")
+    genesis = keygen.generate_dkg(dims=50, nodes=4, out_dir=out,
+                                  threshold=2, rng_seed=11)
+    with open(os.path.join(out, "genesis.json")) as f:
+        on_disk = json.load(f)
+    assert on_disk == genesis
+    assert genesis["genesis"] == "dkg"
+    assert genesis["rejected_dealers"] == []
+    assert sorted(genesis["deal_digests"]) == ["0", "1", "2", "3"]
+    assert genesis["label"] == f"biscotti-dkg-v1:{genesis['transcript']}"
+    # the commit key on disk is derived from the transcript-bound label,
+    # not a dealer-chosen string
+    with open(os.path.join(out, "commit_key.json")) as f:
+        ck = json.load(f)
+    assert ck["label"] == genesis["label"]
+    assert ck["dims"] == 50
+    # identity + peers files match the dealer layout (format-compatible)
+    with open(os.path.join(out, "node_keys.json")) as f:
+        assert sorted(json.load(f)) == ["0", "1", "2", "3"]
+    # replayable: same seed, same transcript
+    out2 = str(tmp_path / "keys2")
+    again = keygen.generate_dkg(dims=50, nodes=4, out_dir=out2,
+                                threshold=2, rng_seed=11)
+    assert again["transcript"] == genesis["transcript"]
+
+
+def test_dkg_keyed_cluster_boots_and_mints(tmp_path):
+    """The boot claim: a cluster keyed by `--genesis dkg` runs the keyed
+    protocol path end to end — Pedersen commitments under the
+    transcript-derived key, chains equal, nothing rejected — exactly as
+    a dealer-keyed cluster would (tests/test_keyed_cluster.py), with no
+    dealer anywhere in the trust path."""
+    from biscotti_tpu.config import BiscottiConfig, Timeouts
+    from biscotti_tpu.runtime.peer import PeerAgent
+    from biscotti_tpu.tools import keygen
+
+    n = 3
+    out = str(tmp_path / "keys")
+    keygen.generate_dkg(dims=50, nodes=n, out_dir=out, threshold=2,
+                        rng_seed=5)
+    fast = Timeouts(update_s=4.0, block_s=20.0, krum_s=4.0, share_s=4.0,
+                    rpc_s=6.0)
+    cfgs = [BiscottiConfig(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=15940,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=True,
+        max_iterations=2, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=fast, seed=3) for i in range(n)]
+
+    async def go():
+        agents = [PeerAgent(c, key_dir=out) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    results, agents = asyncio.run(go())
+    dumps = {r["chain_dump"] for r in results}
+    assert len(dumps) == 1, "DKG-keyed cluster forked"
+    accepted = [u for b in agents[0].chain.blocks
+                for u in b.data.deltas if u.accepted]
+    assert accepted, "DKG-keyed cluster minted nothing"
+    for u in accepted:
+        assert len(u.commitment) == 32
+    assert all(a.commit_key is not None for a in agents)
+    assert sum(a.counters.get("submission_rejected", 0)
+               for a in agents) == 0

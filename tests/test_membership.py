@@ -487,15 +487,17 @@ def test_reshare_round_recovers_after_miner_loss():
         # the default pre-election role map has NO miners: wait for the
         # round-0 election itself, not just the round counter
         await _wait_until(lambda: len(a0.role_map.committee()[1]) >= 2,
-                          what="round-0 committee election")
+                          what="round-0 committee election", poll=0)
         _, miners, _, _ = a0.role_map.committee()
         miners = sorted(miners)
         victim = [m for m in miners if m != max(miners)][0]
         # condition-driven kill: the moment the victim HOLDS share rows
-        # (it is a live share-holder), tear it down mid-round
+        # (it is a live share-holder), tear it down mid-round. poll=0:
+        # a warm round completes in less than the default poll interval,
+        # and a kill landing BETWEEN rounds is never observed as a loss
         await _wait_until(
             lambda: agents[victim].counters.get("secret_registered", 0) >= 1,
-            what="victim to receive share rows")
+            what="victim to receive share rows", poll=0)
         t = tasks[victim]
         t.cancel()
         try:

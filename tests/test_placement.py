@@ -1,0 +1,435 @@
+"""Elastic fleet plane (runtime/placement.py, docs/PLACEMENT.md).
+
+Unit level: the shared layout helper (launcher + overlay consume ONE
+function), pure seeded placement decisions, pressure attribution, and
+ticket wire round-trips.
+
+Integration level (`-m placement` isolates): defaults-off bit-identity
+(the structural guard — a disabled plan cannot construct a controller
+object, emits no `biscotti_migration_*` metric, and leaves the seed
+schedule untouched), the migration ticket driven through the controller
+seams OUTSIDE the churn plane (a migrated peer's stake, breaker ledger,
+admission buckets, EF residual, and round position survive the move; a
+forged ticket is refused like a forged snapshot), mid-intake migration
+degrading to the per-member fallback instead of a stalled mint, and —
+slow-marked — the ISSUE 19 acceptance run: a seeded plan moves >= 2
+peers between hives mid-training at N=100 with secure-agg +
+verification on, surviving-prefix oracle equal and zero honest stake
+debits."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from biscotti_tpu.config import BiscottiConfig, Timeouts
+from biscotti_tpu.runtime import placement
+from biscotti_tpu.runtime.hive import LoopbackHub
+from biscotti_tpu.runtime.membership import surviving_prefix_oracle
+from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.runtime.placement import (HostSignals, Move,
+                                            PlacementController,
+                                            PlacementPlan,
+                                            aligned_overlay_group, decide,
+                                            hive_layout, host_pressure)
+
+pytestmark = pytest.mark.placement
+
+FAST = Timeouts(update_s=5.0, block_s=20.0, krum_s=4.0, share_s=5.0,
+                rpc_s=6.0)
+
+
+def _cfg(i, n, port, **kw):
+    base = dict(
+        node_id=i, num_nodes=n, dataset="creditcard", base_port=port,
+        num_verifiers=1, num_miners=1, num_noisers=1,
+        secure_agg=False, noising=False, verification=False,
+        max_iterations=2, convergence_error=0.0, sample_percent=1.0,
+        batch_size=8, timeouts=FAST, seed=3,
+    )
+    base.update(kw)
+    return BiscottiConfig(**base)
+
+
+# ---------------------------------------------------------------- layout
+
+
+def test_hive_layout_is_contiguous_and_balanced():
+    assert hive_layout(10, 3) == [(0, 4), (4, 3), (7, 3)]
+    assert hive_layout(9, 3) == [(0, 3), (3, 3), (6, 3)]
+    assert hive_layout(5, 8) == [(0, 1), (1, 1), (2, 1), (3, 1), (4, 1),
+                                 (5, 0), (5, 0), (5, 0)]
+    # per_host pins every host (pod_launch --peers-per-host)
+    assert hive_layout(0, 2, per_host=4) == [(0, 4), (4, 4)]
+    with pytest.raises(ValueError):
+        hive_layout(4, 0)
+
+
+def test_aligned_overlay_group_is_gcd_of_counts():
+    assert aligned_overlay_group([(0, 4), (4, 4)]) == 4
+    assert aligned_overlay_group([(0, 4), (4, 6)]) == 2
+    # an uneven resize degrades to group 1 instead of straddling hosts
+    assert aligned_overlay_group([(0, 3), (3, 5)]) == 1
+    assert aligned_overlay_group([(0, 0)]) == 1
+    assert aligned_overlay_group([]) == 1
+
+
+# ------------------------------------------------------------- decisions
+
+
+def _sig(hid, peers, **kw):
+    return HostSignals(hive_id=hid, peers=tuple(peers), **kw)
+
+
+def test_host_pressure_names_dominant_signal():
+    plan = PlacementPlan(enabled=True, rss_hot_bytes=100,
+                         lag_hot_s=0.1)
+    # rss 3x over threshold dominates lag 1.5x over threshold
+    p, why = host_pressure(plan, _sig("h", [0], rss_bytes=300,
+                                      loop_lag_s=0.15))
+    assert why == "rss" and p == pytest.approx(2.0 + 0.5)
+    # a disarmed signal (threshold 0) never contributes
+    plan0 = PlacementPlan(enabled=True, rss_hot_bytes=0, lag_hot_s=0.1)
+    p0, why0 = host_pressure(plan0, _sig("h", [0], rss_bytes=10 ** 12,
+                                         loop_lag_s=0.15))
+    assert why0 == "loop_lag" and p0 == pytest.approx(0.5)
+    # idle host scores <= 0
+    p1, _ = host_pressure(plan, _sig("h", [0]))
+    assert p1 <= 0.0
+
+
+def test_decide_is_pure_and_seeded():
+    plan = PlacementPlan(enabled=True, seed=11, max_moves=2)
+    sigs = [_sig("hot", [0, 1, 2, 3], loop_lag_s=1.0),
+            _sig("cold", [4, 5])]
+    a = decide(plan, sigs, 2)
+    b = decide(plan, sigs, 2)
+    assert a == b, "decide must be pure in (seed, round, signals)"
+    assert 1 <= len(a) <= 2
+    for mv in a:
+        assert mv.src == "hot" and mv.dst == "cold"
+        assert mv.node in (0, 1, 2, 3)
+        assert mv.reason == "loop_lag"
+    # the round index is part of the seed material: some round differs
+    # (tie-broken victim), but every round replays to itself
+    for r in (3, 4, 5):
+        assert decide(plan, sigs, r) == decide(plan, sigs, r)
+
+
+def test_decide_prefers_slowest_peer_and_respects_floor():
+    plan = PlacementPlan(enabled=True, seed=0, max_moves=1,
+                         lag_hot_s=0.0, slow_hot=1.5)
+    sigs = [_sig("hot", [0, 1, 2], slow_factors={2: 4.0}),
+            _sig("cold", [3, 4, 5])]
+    (mv,) = decide(plan, sigs, 2)
+    assert mv == Move(node=2, src="hot", dst="cold", reason="slow")
+    # min_hive_peers: a hot host at the floor cannot shed
+    floor = PlacementPlan(enabled=True, min_hive_peers=3, slow_hot=1.5,
+                          lag_hot_s=0.0)
+    assert decide(floor, sigs, 2) == []
+
+
+def test_decide_no_moves_when_disabled_or_nowhere_colder():
+    sigs = [_sig("a", [0, 1], loop_lag_s=1.0),
+            _sig("b", [2, 3], loop_lag_s=1.0)]
+    assert decide(PlacementPlan(), sigs, 2) == []
+    armed = PlacementPlan(enabled=True)
+    # equally hot everywhere: nowhere meaningfully colder, no oscillation
+    assert decide(armed, sigs, 2) == []
+    # a single host has nowhere to move to
+    assert decide(armed, sigs[:1], 2) == []
+
+
+def test_plan_validation():
+    PlacementPlan().validate()  # disabled plans validate vacuously
+    PlacementPlan(enabled=True).validate()
+    with pytest.raises(ValueError):
+        PlacementPlan(enabled=True, interval=0).validate()
+    with pytest.raises(ValueError):
+        PlacementPlan(enabled=True, max_moves=0).validate()
+    with pytest.raises(ValueError):
+        PlacementPlan(enabled=True, shed_hot=-0.1).validate()
+
+
+# -------------------------------------------------- defaults-off guard
+
+
+def test_defaults_off_bit_identity_and_zero_metrics():
+    """The regression guard for `--placement` off: the default config
+    carries a disabled plan, a disabled plan cannot construct a
+    controller object AT ALL (the structural guard — nothing of the
+    plane exists to perturb a run), and a bare cluster emits zero
+    `biscotti_migration_*` / `biscotti_dkg_*` metric families and zero
+    migration counters. (Cross-run chain comparison is deliberately not
+    asserted — live round composition is load-timing dependent; the
+    per-run cross-peer equality oracle is.)"""
+    n = 3
+    cfgs = [_cfg(i, n, 15950) for i in range(n)]
+    assert not cfgs[0].placement_plan.enabled
+
+    with pytest.raises(ValueError, match="requires an enabled"):
+        PlacementController(lambda *a: None, {}, PlacementPlan())
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    results, agents = asyncio.run(go())
+    dumps = {r["chain_dump"] for r in results}
+    assert len(dumps) == 1
+    for r in results:
+        snap = r["telemetry"]
+        assert not any(k.startswith("biscotti_migration_")
+                       or k.startswith("biscotti_dkg_")
+                       for k in snap["metrics"])
+        assert not any(k.startswith("migration_") or k.startswith("dkg_")
+                       for k in snap["counters"])
+    # the drain gate defaults shut: an unmanaged peer refuses every
+    # ticket request (anti-exfiltration — tests/test_upgrade.py holds
+    # the RPC-level claim; here the structural default)
+    assert all(a._drain_token is None for a in agents)
+
+
+# ------------------------------------------- tickets via controller seams
+
+
+def _finished_cluster(port, **kw):
+    n = 3
+    cfgs = [_cfg(i, n, port, **kw) for i in range(n)]
+
+    async def go():
+        agents = [PeerAgent(c) for c in cfgs]
+        results = await asyncio.gather(*(a.run() for a in agents))
+        return results, agents
+
+    return asyncio.run(go())
+
+
+@pytest.mark.parametrize("secure", [False, True])
+def test_ticket_roundtrip_state_survives_move(secure):
+    """The ISSUE's controller-seam satellite: drive the snapshot
+    bootstrap path directly — no churn plane anywhere. A ticket captured
+    from a live peer and fed to `PeerAgent(..., ticket=...)` must carry
+    the chain (stake map included), breaker ledger, admission buckets,
+    EF residual, and round position into the fresh incarnation, through
+    the SAME guarded adoption path a snapshot donor reply takes —
+    parameterized over the secure-agg (resharing-bearing) protocol
+    flavor."""
+    port = 15956 if secure else 15960
+    results, agents = _finished_cluster(port, secure_agg=secure,
+                                        noising=secure)
+    assert len({r["chain_dump"] for r in results}) == 1
+    donor = agents[1]
+    assert donor.chain.latest.iteration >= 1
+
+    # non-trivial ledger state to prove survival (not just defaults)
+    donor.health.record_failure(2)
+    donor.health.record_failure(2)
+    donor.admission.restore_state({"shed_counts": {"update_rate": 5},
+                                   "inflight_peak": 7, "buckets": {}})
+    donor.membership_epoch = 4
+    donor._ef_residual = np.arange(donor.trainer.num_params,
+                                   dtype=np.float64)
+
+    ticket = placement.ticket_from_agent(donor)
+    assert ticket["node"] == 1
+    assert placement.ticket_nbytes(ticket) > 0
+    # the anti-exfiltration contract: no identity material in the ticket
+    assert not any("seed" in k or "key" in k for k in ticket)
+
+    # wire round-trip (what GetMigrationTicket serves / the supervisor
+    # reassembles)
+    meta, arrays = placement.ticket_wire(ticket)
+    assert "chain_arrays" not in meta and "ef_residual" not in meta
+    wired = placement.ticket_unwire(meta, arrays)
+    assert np.array_equal(wired["ef_residual"], donor._ef_residual)
+
+    fresh = PeerAgent(_cfg(1, 3, port, secure_agg=secure,
+                           noising=secure), ticket=wired)
+    try:
+        assert fresh.chain.dump() == donor.chain.dump()
+        assert fresh.chain.latest_stake_map() \
+            == donor.chain.latest_stake_map()
+        assert fresh.iteration == donor.iteration
+        assert fresh.health.export_state()["2"]["failures"] == 2
+        adm = fresh.admission.export_state()
+        assert adm["shed_counts"].get("update_rate", 0) >= 5
+        assert adm["inflight_peak"] >= 7
+        assert fresh.membership_epoch == 4
+        assert np.array_equal(fresh._ef_residual, donor._ef_residual)
+        assert fresh.counters.get("migration_restored") == 1
+    finally:
+        fresh.pool.close()
+        fresh.server.close_now()
+
+
+def test_forged_ticket_refused_like_forged_snapshot():
+    """A tampered chain payload must be refused by the guarded adoption
+    path (structural verify / quorum check), leaving the fresh
+    incarnation at genesis — a migration ticket is not a chain-injection
+    side door."""
+    port = 15964
+    _, agents = _finished_cluster(port)
+    donor = agents[0]
+    ticket = placement.ticket_from_agent(donor)
+    for key, arr in ticket["chain_arrays"].items():
+        if np.issubdtype(np.asarray(arr).dtype, np.floating):
+            ticket["chain_arrays"][key] = np.asarray(arr) + 1.0
+    forged = PeerAgent(_cfg(0, 3, port), ticket=ticket)
+    try:
+        # adoption refused: the chain never left genesis (iteration -1),
+        # and the restore trace records that nothing was adopted
+        assert forged.chain.latest.iteration == -1
+        assert len(forged.chain.blocks) == 1
+        assert forged.chain.latest.iteration \
+            < donor.chain.latest.iteration
+    finally:
+        forged.pool.close()
+        forged.server.close_now()
+
+
+# ------------------------------------------------- live migration runs
+
+
+def _two_host_fixture(n, port, plan, victim, iterations=3, **kw):
+    """A two-hive cluster under the controller with the victim pinned
+    through the slow-factor signal (the signals_fn seam the ISSUE
+    names): host0 carries every peer and reads hot, host1 starts empty,
+    so the seeded decision must move `victim` across."""
+    cfg = _cfg(0, n, port, max_iterations=iterations,
+               placement_plan=plan, **kw)
+    cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
+        n, cfg.num_verifiers, cfg.num_miners))
+    hubs = {"host0": LoopbackHub(), "host1": LoopbackHub()}
+    assignment = {i: "host0" for i in range(n)}
+
+    def make_agent(node, hive_id, ticket):
+        return PeerAgent(cfg.replace(node_id=node), hive=hubs[hive_id],
+                         ticket=ticket)
+
+    def signals(assignment, agents):
+        by = {"host0": [], "host1": []}
+        for node, hid in sorted(assignment.items()):
+            by[hid].append(node)
+        return [HostSignals(hive_id=hid, peers=tuple(nodes),
+                            slow_factors=({victim: 9.0}
+                                          if victim in nodes else {}))
+                for hid, nodes in sorted(by.items())]
+
+    return PlacementController(make_agent, assignment, plan,
+                               signals_fn=signals)
+
+
+def test_mid_intake_migration_degrades_not_stalls():
+    """Mid-training migration of an overlay group member: the move lands
+    between round 1's decision point and round 3's close — mid-intake
+    from the miner's perspective — and the mint must DEGRADE (per-member
+    fallback intake, docs/OVERLAY.md) rather than stall: the run
+    completes every round, the surviving prefix stays equal, and the
+    migrated incarnation carries its restored state."""
+    plan = PlacementPlan(enabled=True, seed=5, interval=1, max_moves=1,
+                         lag_hot_s=0.0, slow_hot=1.5, min_hive_peers=1)
+    ctl = _two_host_fixture(4, 15970, plan, victim=3, iterations=3,
+                            overlay_group=2)
+
+    async def go():
+        return await asyncio.wait_for(ctl.run(), 180)
+
+    results = asyncio.run(go())
+    equal, _, real = surviving_prefix_oracle(results)
+    assert equal, "migration forked the chain"
+    assert real >= 2, "the mint stalled"
+    assert [n for _, n, _, _ in ctl.moves_applied] == [3]
+    moved = next(r for r in results if r["node"] == 3)
+    assert moved["hive"] == "host1" and moved["migrations"] == 1
+    assert moved["counters"].get("migration_restored") == 1
+    anchor = next(r for r in results if r["node"] == 0)
+    assert anchor["iterations"] >= 3, "anchor never finished its rounds"
+    # controller bookkeeping mirrors what chaos/soak reports embed
+    s = ctl.summary()
+    assert s["moves"] and s["downtime_s"] and s["ticket_bytes"]
+    assert s["assignment"]["3"] == "host1"
+
+
+def test_migration_metrics_emitted_when_registry_attached():
+    from biscotti_tpu.telemetry.registry import MetricsRegistry
+
+    plan = PlacementPlan(enabled=True, seed=5, interval=1, max_moves=1,
+                         lag_hot_s=0.0, slow_hot=1.5)
+    ctl = _two_host_fixture(3, 15976, plan, victim=2, iterations=2)
+    reg = MetricsRegistry()
+    ctl.registry = reg
+
+    results = asyncio.run(asyncio.wait_for(ctl.run(), 180))
+    equal, _, _ = surviving_prefix_oracle(results)
+    assert equal
+    assert len(ctl.moves_applied) == 1
+    snap = reg.snapshot()
+    moves = snap[placement.MOVES_METRIC]["series"]
+    assert [(r["labels"]["reason"], r["value"]) for r in moves] \
+        == [("slow", 1.0)]
+    assert snap[placement.DOWNTIME_METRIC]["series"][0]["count"] == 1
+    assert snap[placement.TICKET_BYTES_METRIC]["series"][0]["sum"] > 0
+
+
+@pytest.mark.slow
+def test_acceptance_rebalance_n100_secureagg_verification():
+    """ISSUE 19 acceptance: a seeded placement plan moves >= 2 peers
+    between hives mid-training at N=100 with secure-agg + verification
+    on — surviving-prefix oracle equal, migrated peers' state intact,
+    and ZERO honest stake debits (nobody's stake drops below the
+    default: the move must not read as an offense to any verifier)."""
+    n = 100
+    plan = PlacementPlan(enabled=True, seed=0, interval=1, max_moves=2,
+                         lag_hot_s=0.05)
+    layout = hive_layout(n, 2)
+    assert aligned_overlay_group(layout) == 50
+    hive_ids = ["host0", "host1"]
+    assignment = {}
+    for hid, (start, count) in zip(hive_ids, layout):
+        for node in range(start, start + count):
+            assignment[node] = hid
+    cfg = _cfg(0, n, 16100, secure_agg=True, noising=True,
+               verification=True, sample_percent=0.2,
+               placement_plan=plan)
+    cfg = cfg.replace(timeouts=cfg.timeouts.scaled(
+        n, cfg.num_verifiers, cfg.num_miners))
+    hubs = {hid: LoopbackHub() for hid in hive_ids}
+    made = {}
+
+    def make_agent(node, hive_id, ticket):
+        a = PeerAgent(cfg.replace(node_id=node), hive=hubs[hive_id],
+                      ticket=ticket)
+        made[node] = a
+        return a
+
+    def rigged(assignment, agents):
+        # process-wide gauges read equally hot on one box: inject the
+        # pressure through the signals_fn seam (same rig as bench.py)
+        by = {}
+        for node, hid in sorted(assignment.items()):
+            by.setdefault(hid, []).append(node)
+        return [HostSignals(hive_id=hid, peers=tuple(nodes),
+                            loop_lag_s=1.0 if hid == "host0" else 0.0)
+                for hid, nodes in sorted(by.items())]
+
+    ctl = PlacementController(make_agent, assignment, plan,
+                              signals_fn=rigged)
+    results = asyncio.run(asyncio.wait_for(ctl.run(), 900))
+
+    equal, _, real = surviving_prefix_oracle(results)
+    assert equal, "rebalance forked the chain"
+    assert real >= 1
+    assert len(ctl.moves_applied) >= 2, \
+        f"expected >= 2 moves, got {ctl.summary()['moves']}"
+    for _, node, src, dst in ctl.moves_applied:
+        assert src == "host0" and dst == "host1"
+        r = next(x for x in results if x["node"] == node)
+        assert r["migrations"] >= 1
+        assert r["counters"].get("migration_restored", 0) >= 1
+    # zero honest stake debits: every peer ends at or above the default
+    stake = made[0].chain.latest_stake_map()
+    assert len(stake) == n
+    assert all(v >= cfg.default_stake for v in stake.values()), \
+        f"honest stake debited: {sorted(stake.items())[:5]}..."

@@ -56,9 +56,10 @@ def test_version_rows_are_cumulative_and_bounded():
 def test_version_history_is_pinned():
     """The PR-by-PR protocol history is a contract: codecs entered at
     v2, admission busy-status at v3, snapshot bootstrap at v4, overlay
-    relay at v5, trace at v6, structured advertisement at v7. Moving a
-    row rewrites history that deployed builds already advertise."""
-    assert protocol.CURRENT_VERSION == 7
+    relay at v5, trace at v6, structured advertisement at v7, the
+    elastic fleet plane (migration drains + genesis DKG) at v8. Moving
+    a row rewrites history that deployed builds already advertise."""
+    assert protocol.CURRENT_VERSION == 8
     f = protocol.FEATURES
     assert f[protocol.RAW].version == 0
     assert all(f[c].version == 2
@@ -68,10 +69,14 @@ def test_version_history_is_pinned():
     assert f[protocol.RELAY].version == 5
     assert f[protocol.TRACE].version == 6
     assert f[protocol.PROTO].version == 7
+    assert f[protocol.MIGRATE].version == 8
+    assert f[protocol.DKG].version == 8
     m = protocol.MESSAGES
     assert m["RegisterPeer"].version == 0 and not m["RegisterPeer"].feature
     assert m["GetSnapshot"].feature == protocol.SNAPSHOT
     assert m["RelayFrames"].feature == protocol.RELAY
+    assert m["GetMigrationTicket"].feature == protocol.MIGRATE
+    assert m["DkgDeal"].feature == protocol.DKG
     # every gating feature is itself registered, at or before its message
     for msg in m.values():
         if msg.feature:

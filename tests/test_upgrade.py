@@ -13,6 +13,7 @@ import pytest
 from biscotti_tpu.config import BiscottiConfig, Timeouts
 from biscotti_tpu.runtime import protocol
 from biscotti_tpu.runtime.peer import PeerAgent
+from biscotti_tpu.runtime.rpc import RPCError
 from biscotti_tpu.tools import chaos, obs
 
 FAST = Timeouts(update_s=20.0, block_s=60.0, krum_s=20.0, share_s=20.0,
@@ -131,7 +132,8 @@ def test_rolling_upgrade_acceptance_n8_secure_agg(capsys):
 
 
 @pytest.mark.parametrize("argv", [
-    ["--rolling-upgrade", "7"],            # from-current is a no-op drill
+    # from-current is a no-op drill (tracks CURRENT_VERSION as it grows)
+    ["--rolling-upgrade", str(protocol.CURRENT_VERSION)],
     ["--rolling-upgrade", "0", "--protocol-version", "1"],  # conflicting
     ["--protocol-version", "99"],          # beyond the table
     ["--rolling-upgrade", "0", "--rounds", "2"],  # waves outlive the run
@@ -140,3 +142,31 @@ def test_chaos_refuses_mislabeled_upgrade_runs(argv):
     with pytest.raises(SystemExit) as exc:
         chaos.main(["--nodes", "4"] + argv)
     assert exc.value.code == 2
+
+
+def test_v7_pin_answers_elastic_fleet_rpcs_unknown_method():
+    """The v8 rows degrade like every gated message before them: a
+    v7-pinned build IS the old build for `GetMigrationTicket` and
+    `DkgDeal` — its dispatch gate answers both `unknown method` — and a
+    current peer that saw the pinned hello records the lost `migrate` /
+    `dkg` features in the traced+counted degradation readout rather
+    than failing its drain or its ceremony silently."""
+    pinned = PeerAgent(_cfg(0, 2, 13050, protocol_version=7))
+    assert protocol.MIGRATE not in pinned.caps
+    assert protocol.DKG not in pinned.caps
+    for mt in ("GetMigrationTicket", "DkgDeal"):
+        assert not protocol.serves(pinned.caps, mt)
+        with pytest.raises(RPCError, match=f"unknown method {mt}"):
+            asyncio.run(pinned._handle(mt, {}, {}))
+    cur = PeerAgent(_cfg(1, 2, 13055))
+    assert {protocol.MIGRATE, protocol.DKG} <= cur.caps
+    for mt in ("GetMigrationTicket", "DkgDeal"):
+        assert protocol.serves(cur.caps, mt)
+    before = cur.counters.get("feature_degraded", 0)
+    cur._record_caps(0, sorted(pinned.caps))
+    assert {protocol.MIGRATE, protocol.DKG} <= cur._degraded_seen[0]
+    assert cur.counters.get("feature_degraded", 0) >= before + 2
+    # an unauthorized drain on a CURRENT build is refused by the token
+    # gate, not by the protocol row — distinct, deliberate errors
+    with pytest.raises(RPCError, match="migration not authorized"):
+        asyncio.run(cur._handle("GetMigrationTicket", {}, {}))
